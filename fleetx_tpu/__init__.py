@@ -1,0 +1,16 @@
+"""fleetx_tpu — a TPU-native large-model training framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of PaddleFleetX
+(reference: /root/reference, see SURVEY.md): one-stop train / eval / generate /
+export / serve tooling for GPT, ViT, ERNIE and Imagen model families, driven by
+YAML configs with ``_base_`` inheritance and CLI overrides, a Lightning-style
+Module protocol, and an Engine loop with mixed precision, activation
+rematerialisation, checkpoint/resume, profiling and throughput logging.
+
+Parallelism is expressed TPU-first: one named ``jax.sharding.Mesh`` over
+ICI/DCN carrying ``(pipe, data, fsdp, seq, tensor)`` axes, pjit/GSPMD for
+collective insertion, ``shard_map`` where an explicit schedule matters (1F1B
+pipeline, ring attention), and Pallas kernels for flash attention.
+"""
+
+__version__ = "0.1.0"

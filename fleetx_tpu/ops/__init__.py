@@ -1,0 +1,1 @@
+from fleetx_tpu.ops import flash_attention  # noqa: F401

@@ -1,0 +1,332 @@
+"""Pallas flash attention (FlashAttention-2) for TPU.
+
+Replaces the reference's fused attention core — ``core_attn`` with
+``incubate.softmax_mask_fuse_upper_triangle``
+(``hybrid_model.py:268-298``) — with a blockwise online-softmax kernel that
+never materialises the [S, S] score matrix in HBM:
+
+- forward: one pass over K/V blocks per Q block, f32 accumulators in VMEM,
+  causal blocks skipped entirely (2x FLOP saving);
+- backward: FlashAttention-2 style — a dq kernel and a dk/dv kernel that
+  recompute P from the saved logsumexp, so residual memory is O(S) not O(S^2).
+
+Layout contract: q, k, v are [batch, seq, heads, head_dim] (the model's
+``bsnd``); internally reshaped to [batch*heads, seq, head_dim].
+
+Falls back automatically (``supported()``) when shapes don't tile; on CPU the
+kernel runs in interpreter mode so the same code path is unit-testable without
+hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only importable on TPU-enabled builds; interpret mode needs it too
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def supported(q: jax.Array, block_q: int = DEFAULT_BLOCK_Q,
+              block_k: int = DEFAULT_BLOCK_K) -> bool:
+    """True when the pallas path applies: seq tiles into blocks and head_dim
+    is MXU-friendly."""
+    if pltpu is None:
+        return False
+    if q.ndim != 4:
+        return False
+    seq, head_dim = q.shape[1], q.shape[3]
+    if seq % min(seq, block_q) or seq % min(seq, block_k):
+        return False
+    if seq < 128 or seq % 128:
+        return False
+    return head_dim in (64, 128, 256)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
+                block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+    run = True
+    if causal:
+        # skip blocks fully above the diagonal
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=1)
+        m_ref[:, 0] = m_new
+        v = v_ref[0].astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l_safe)
+
+
+def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k):
+    bn, sq, d = q3.shape
+    sk = k3.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bn, sq // block_q, sk // block_k)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bn, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            _VMEM((block_q, d), jnp.float32),
+            _VMEM((block_q, 128), jnp.float32),
+            _VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2: recompute P per block from saved logsumexp)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        acc_ref[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, residuals, g):
+    q3, k3, v3, out, lse = residuals
+    do = g
+    bn, sq, d = q3.shape
+    sk = k3.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    delta = (out.astype(jnp.float32) * do.astype(jnp.float32)).sum(axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bn, sq // bq, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bq), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, bq), lambda h, i, j: (h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bn, sq, d), q3.dtype),
+        scratch_shapes=[_VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bn, sk // bk, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq), lambda h, j, i: (h, i)),
+            pl.BlockSpec((1, bq), lambda h, j, i: (h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bn, sk, d), v3.dtype),
+        ],
+        scratch_shapes=[_VMEM((bk, d), jnp.float32), _VMEM((bk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash3(q3, k3, v3, scale, causal, block_q, block_k):
+    out, _ = _fwd(q3, k3, v3, scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k):
+    out, lse = _fwd(q3, k3, v3, scale=scale, causal=causal,
+                    block_q=block_q, block_k=block_k)
+    return out, (q3, k3, v3, out, lse)
+
+
+_flash3.defvjp(_flash3_fwd, _bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: float | None = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Blockwise causal attention. q/k/v: [batch, seq, heads, head_dim]."""
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    def to3(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+
+    out3 = _flash3(to3(q, sq), to3(k, sk), to3(v, sk), scale, causal,
+                   block_q, block_k)
+    return out3.reshape(b, n, sq, d).transpose(0, 2, 1, 3)
+
+
+def reference_attention(q, k, v, *, causal: bool = True,
+                        scale: float | None = None) -> jax.Array:
+    """Naive O(S^2)-memory attention, used for numerics tests."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bnqk,bknd->bqnd", p.astype(q.dtype), v)

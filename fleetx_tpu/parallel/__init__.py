@@ -1,0 +1,6 @@
+from fleetx_tpu.parallel.mesh import MeshEnv, build_mesh, get_mesh, set_mesh  # noqa: F401
+from fleetx_tpu.parallel.sharding import (  # noqa: F401
+    make_axis_rules,
+    logical_sharding,
+    zero_sharding,
+)

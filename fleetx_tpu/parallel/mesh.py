@@ -1,0 +1,103 @@
+"""Named device mesh over ICI/DCN — the parallelism substrate.
+
+Replaces the reference's NCCL hybrid-parallel topology (HCG process groups
+built by ``fleet.init`` from ``DistributedStrategy.hybrid_configs``,
+``ppfleetx/utils/env.py:49-69``) with one ``jax.sharding.Mesh`` carrying named
+axes::
+
+    (pipe, data, fsdp, seq, tensor)
+
+- ``data``   — pure data parallelism (grad sync inserted by GSPMD)
+- ``fsdp``   — ZeRO/sharding axis (param/optimizer-state sharding)
+- ``tensor`` — Megatron tensor parallelism (innermost: highest-bandwidth ICI
+  neighbours carry the per-layer collectives)
+- ``seq``    — context parallelism for long sequences (ring attention)
+- ``pipe``   — pipeline stages (explicit ``shard_map`` + ``ppermute`` schedule)
+
+The HCG "get_*_group/rank" API surface maps to mesh-axis lookups on
+``MeshEnv``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from fleetx_tpu.utils.log import logger
+
+MESH_AXES = ("pipe", "data", "fsdp", "seq", "tensor")
+
+_global_mesh: Mesh | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshEnv:
+    """HCG-equivalent view of the mesh (reference ``eager_engine.py:175-186``)."""
+
+    mesh: Mesh
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape[name]
+
+    @property
+    def dp_world_size(self) -> int:
+        # the reference treats dp x sharding as the data axis (env.py:76-96)
+        return self.axis_size("data") * self.axis_size("fsdp")
+
+    @property
+    def mp_world_size(self) -> int:
+        return self.axis_size("tensor")
+
+    @property
+    def pp_world_size(self) -> int:
+        return self.axis_size("pipe")
+
+    @property
+    def sp_world_size(self) -> int:
+        return self.axis_size("seq")
+
+
+def build_mesh(dist_config: dict | None = None, devices: list | None = None) -> Mesh:
+    """Build the named mesh from a ``Distributed`` config section.
+
+    Degrees default to 1; the ``data`` axis absorbs the remaining devices
+    (mirrors the degree derivation in reference ``utils/config.py:30-65``).
+    ``mesh_utils.create_device_mesh`` lays the axes out so that the innermost
+    (``tensor``) axis lands on nearest-neighbour ICI links.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    cfg = dist_config or {}
+    pp = int(cfg.get("pp_degree") or 1)
+    fsdp = int(cfg.get("fsdp_degree") or 1)
+    seq = int(cfg.get("seq_degree") or 1)
+    mp = int(cfg.get("mp_degree") or 1)
+    fixed = pp * fsdp * seq * mp
+    dp = int(cfg.get("dp_degree") or 0) or n // fixed
+    shape = (pp, dp, fsdp, seq, mp)
+    assert int(np.prod(shape)) == n, f"mesh shape {shape} != {n} devices"
+    if n == 1:
+        device_array = np.asarray(devices).reshape(shape)
+    else:
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    mesh = Mesh(device_array, MESH_AXES)
+    logger.info("mesh: %s over %d devices (%s)", dict(zip(MESH_AXES, shape)), n,
+                devices[0].platform)
+    return mesh
+
+
+def set_mesh(mesh: Mesh) -> Mesh:
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = build_mesh()
+    return _global_mesh

@@ -1,0 +1,110 @@
+"""Logical-axis sharding rules — the GSPMD expression of hybrid parallelism.
+
+The reference wires tensor parallelism through explicit Megatron layers
+(``ColumnParallelLinear``/``RowParallelLinear``/``VocabParallelEmbedding``,
+consumed at ``hybrid_model.py:111-112,590``) and ZeRO through
+``group_sharded_parallel`` (``eager_engine.py:228-242``).  Here both are pure
+metadata: model code annotates parameters/activations with *logical* axis
+names, and one rule table maps logical names to mesh axes.  GSPMD then inserts
+exactly the collectives the reference hand-wires (all-reduce after row-parallel
+matmul, all-gather for sequence parallelism, reduce-scatter for ZeRO grads).
+
+Logical axis vocabulary:
+
+- params: ``vocab, embed, mlp, heads, kv, layers``
+- activations: ``batch, act_seq, act_embed, act_heads``
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+__all__ = ["make_axis_rules", "logical_sharding", "zero_sharding", "shard_logical"]
+
+
+def make_axis_rules(dist_config: dict | None = None) -> tuple[tuple[str, Any], ...]:
+    """Build logical→mesh axis rules from a ``Distributed`` config section.
+
+    - tensor parallelism: ``vocab/mlp/heads → tensor`` (Megatron column/row
+      splits, reference ``hybrid_model.py:111-119``)
+    - ZeRO stage 3: additionally ``embed → fsdp`` (param sharding, the
+      ``group_sharded_parallel(level="p_g_os")`` analogue)
+    - Megatron-SP (``sequence_parallel: true``): activations sharded
+      ``act_seq → tensor`` (reference ``sequence_parallel_utils.py:150-326``)
+    - context parallelism: ``act_seq → seq`` (ring attention axis — the
+      long-context capability the reference lacks)
+    """
+    cfg = dist_config or {}
+    stage = int((cfg.get("sharding") or {}).get("sharding_stage") or 0)
+    sp = bool(cfg.get("sequence_parallel"))
+
+    act_seq: Any = ("seq", "tensor") if sp else ("seq",)
+    rules: list[tuple[str, Any]] = [
+        ("batch", ("data", "fsdp")),
+        ("vocab", "tensor"),
+        ("mlp", "tensor"),
+        ("heads", "tensor"),
+        ("kv", None),
+        ("layers", None),
+        ("norm", None),
+        ("embed", "fsdp" if stage >= 3 else None),
+        ("act_seq", act_seq),
+        ("act_embed", None),
+        ("act_heads", "tensor"),
+        ("act_kv", None),
+        ("act_vocab", "tensor"),
+    ]
+    return tuple(rules)
+
+
+def logical_sharding(abstract_tree: Any, mesh: Mesh,
+                     rules: tuple[tuple[str, Any], ...]) -> Any:
+    """Map a tree of logically-annotated abstract arrays to NamedShardings."""
+    specs = nn.get_partition_spec(abstract_tree)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, nn.logical_to_mesh_axes(spec, rules)),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_logical(x: jax.Array, logical_axes: tuple[str | None, ...],
+                  rules: tuple[tuple[str, Any], ...]) -> jax.Array:
+    """Constrain an activation to its logical sharding inside jit."""
+    spec = nn.logical_to_mesh_axes(P(*logical_axes), rules)
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def zero_sharding(tree: Any, mesh: Mesh, axis: str = "fsdp",
+                  param_shardings: Any = None) -> Any:
+    """ZeRO-1/2 optimizer-state sharding over the ``fsdp`` axis.
+
+    The reference's sharding stage 1/2 (``group_sharded_parallel`` with
+    ``level="os_g"``, ``eager_engine.py:228-242``) shards optimizer state while
+    keeping params replicated.  Here: for each optimizer-state leaf, shard the
+    first dimension divisible by the fsdp axis size; leaves with no divisible
+    dimension (scalars, small vectors) stay replicated.  Leaves that already
+    carry a non-replicated param sharding (stage 3 / tensor parallel) keep it.
+    """
+    size = mesh.shape[axis]
+
+    def leaf_sharding(leaf: Any, existing: Any = None) -> Any:
+        if existing is not None and any(s is not None for s in getattr(existing, "spec", P())):
+            return existing
+        shape = getattr(leaf, "shape", ())
+        if size > 1:
+            for dim, d in enumerate(shape):
+                if d % size == 0 and d >= size:
+                    spec = [None] * len(shape)
+                    spec[dim] = axis
+                    return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    if param_shardings is not None:
+        return jax.tree.map(leaf_sharding, tree, param_shardings)
+    return jax.tree.map(leaf_sharding, tree)

@@ -1,0 +1,2 @@
+from fleetx_tpu.utils import config, env, log  # noqa: F401
+from fleetx_tpu.utils.log import logger  # noqa: F401

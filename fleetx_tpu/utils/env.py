@@ -1,0 +1,78 @@
+"""Distributed environment bootstrap and RNG policy.
+
+Re-designs ``ppfleetx/utils/env.py:27-96``. The reference builds NCCL hybrid
+process groups (``fleet.init`` + ``DistributedStrategy.hybrid_configs``) and
+tracks per-rank RNG state for mp-correct dropout; here the process bootstrap is
+``jax.distributed.initialize`` and the RNG policy is functional: one global
+seed, split into named streams (params / dropout / data) via
+``jax.random.fold_in``.  Dropout inside tensor-parallel regions is made
+mp-correct for free because JAX PRNG keys are carried in the traced program and
+sharded consistently by GSPMD, unlike the reference's stateful per-rank seed
+trackers (``env.py:41-46``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from fleetx_tpu.utils.log import logger
+
+_initialized = False
+
+
+def init_dist_env(coordinator_address: str | None = None,
+                  num_processes: int | None = None,
+                  process_id: int | None = None) -> None:
+    """Initialize multi-host JAX if requested via env or args.
+
+    Single-host (the common dev case) is a no-op: ``jax.devices()`` already
+    sees the local chips. Multi-host pods set ``FLEETX_COORDINATOR`` etc. or
+    rely on TPU metadata auto-detection inside ``jax.distributed.initialize``.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("FLEETX_COORDINATOR")
+    if coordinator_address or os.environ.get("FLEETX_MULTIHOST"):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes or int(os.environ.get("FLEETX_NUM_PROCESSES", 0)) or None,
+            process_id=process_id if process_id is not None
+            else (int(os.environ["FLEETX_PROCESS_ID"]) if "FLEETX_PROCESS_ID" in os.environ else None),
+        )
+        logger.info("jax.distributed initialized: process %d/%d",
+                    jax.process_index(), jax.process_count())
+    _initialized = True
+
+
+def set_seed(seed: int) -> jax.Array:
+    """Return the root PRNG key for a run (reference ``env.py:27-46``).
+
+    The reference derives distinct numpy/random/paddle seeds per rank plus
+    model-parallel RNG trackers; with JAX a single root key suffices — streams
+    are split functionally and device placement is handled by sharding.
+    """
+    import numpy as np
+    import random
+
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+STREAMS = ("params", "dropout", "data", "sample")
+
+
+def rng_streams(root: jax.Array, names: tuple[str, ...] = STREAMS) -> dict[str, jax.Array]:
+    """Split the root key into named streams, stable under name ordering."""
+    return {name: jax.random.fold_in(root, i) for i, name in enumerate(names)}
+
+
+def get_world_size() -> int:
+    return jax.device_count()
+
+
+def get_local_world_size() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,66 @@
+"""Colored logger with custom TRAIN/EVAL levels.
+
+Re-designs the reference logger (``ppfleetx/utils/log.py:30-175``): same
+custom TRAIN/EVAL log levels and per-step metric lines, implemented with
+stdlib logging + ANSI colors (no colorlog dependency).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+TRAIN = 21
+EVAL = 22
+logging.addLevelName(TRAIN, "TRAIN")
+logging.addLevelName(EVAL, "EVAL")
+
+_COLORS = {
+    "DEBUG": "\033[37m",
+    "INFO": "\033[36m",
+    "TRAIN": "\033[32m",
+    "EVAL": "\033[33m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[41m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelname, "")
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+class _Logger(logging.Logger):
+    def train(self, msg, *args, **kwargs):
+        if self.isEnabledFor(TRAIN):
+            self._log(TRAIN, msg, args, **kwargs)
+
+    def eval(self, msg, *args, **kwargs):
+        if self.isEnabledFor(EVAL):
+            self._log(EVAL, msg, args, **kwargs)
+
+
+logging.setLoggerClass(_Logger)
+logger: _Logger = logging.getLogger("fleetx_tpu")  # type: ignore[assignment]
+logging.setLoggerClass(logging.Logger)
+
+if not logger.handlers:
+    _handler = logging.StreamHandler(sys.stderr)
+    _handler.setFormatter(_ColorFormatter(
+        "[%(asctime)s] [%(levelname)8s] %(message)s", datefmt="%Y-%m-%d %H:%M:%S"))
+    logger.addHandler(_handler)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+
+
+def advertise() -> None:
+    """Startup banner (reference ``utils/log.py`` ``advertise()``)."""
+    logger.info("=" * 60)
+    logger.info("fleetx_tpu — TPU-native large-model training framework")
+    logger.info("=" * 60)
