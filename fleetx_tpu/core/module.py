@@ -1,0 +1,165 @@
+"""Module protocol — the Lightning-style task abstraction, made functional.
+
+Re-designs ``ppfleetx/core/module/basic_module.py:226-283`` and the GPT glue in
+``ppfleetx/models/language_model/language_module.py``. The reference protocol
+is stateful (module owns parameters, ``training_step`` mutates); here a module
+is a *recipe*: it builds the flax model, initialises parameters, and exposes
+pure loss functions the engine can ``jax.value_and_grad`` + ``jit`` over a
+mesh. Host-side hooks (``training_step_end`` logging etc.) stay imperative.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.utils.log import logger
+
+
+class BasicModule:
+    """Task protocol consumed by the engine (reference ``basic_module.py:226``).
+
+    Subclasses implement:
+
+    - ``get_model()``          → a flax module
+    - ``training_loss(params, batch, rng, step)`` → ``(loss, metrics)`` pure fn
+    - ``validation_loss(params, batch)``          → ``(loss, metrics)`` pure fn
+
+    and may override the host-side hooks. ``batch`` is a dict of arrays whose
+    leading dim is the (global) batch.
+    """
+
+    def __init__(self, cfg: Any):
+        self.cfg = cfg
+        self.model = self.get_model()
+        self.nranks = jax.device_count()
+
+    # -- construction --------------------------------------------------------
+    def get_model(self):
+        raise NotImplementedError
+
+    def init_variables(self, rng: jax.Array, batch: dict) -> Any:
+        """Initialise the (logically-annotated) parameter pytree."""
+        raise NotImplementedError
+
+    # -- pure functions ------------------------------------------------------
+    def training_loss(self, params: Any, batch: dict, rng: jax.Array,
+                      step: jax.Array) -> tuple[jax.Array, dict]:
+        raise NotImplementedError
+
+    def validation_loss(self, params: Any, batch: dict) -> tuple[jax.Array, dict]:
+        raise NotImplementedError
+
+    # -- host-side hooks (reference basic_module.py:239-283) -----------------
+    def pretreating_batch(self, batch: dict) -> dict:
+        return batch
+
+    def training_step_end(self, log_dict: dict) -> None:
+        logger.info(
+            "[train] epoch: %d, batch: %d, loss: %.9f, avg_batch_cost: %.5f sec",
+            log_dict.get("epoch", 0), log_dict["batch"], log_dict["loss"],
+            log_dict.get("train_cost", 0.0))
+
+    def validation_step_end(self, log_dict: dict) -> None:
+        logger.info(
+            "[eval] epoch: %d, batch: %d, loss: %.9f, avg_eval_cost: %.5f sec",
+            log_dict.get("epoch", 0), log_dict["batch"], log_dict["loss"],
+            log_dict.get("eval_cost", 0.0))
+
+    def input_spec(self) -> Any:
+        """Abstract input signature for export/AOT (reference ``input_spec``)."""
+        return None
+
+
+class LanguageModule(BasicModule):
+    """Shared GPT-family glue (reference ``language_module.py:31-111``):
+    token/ips metric lines and the model-size banner."""
+
+    tokens_per_sample: int = 1024
+
+    def training_step_end(self, log_dict: dict) -> None:
+        speed = 1.0 / max(log_dict.get("train_cost", 1e-9), 1e-9)
+        default_global_tokens_num = log_dict.get(
+            "global_batch_size", log_dict.get("batch_size", 1)) * self.tokens_per_sample
+        logger.info(
+            "[train] global step %d, epoch: %d, batch: %d, loss: %.9f, "
+            "avg_batch_cost: %.5f sec, speed: %.2f step/s, "
+            "ips_total: %.0f tokens/s, ips: %.0f tokens/s, learning rate: %.5e",
+            log_dict["global_step"], log_dict.get("epoch", 0), log_dict["batch"],
+            log_dict["loss"], log_dict.get("train_cost", 0.0), speed,
+            default_global_tokens_num * speed,
+            default_global_tokens_num * speed / max(self.nranks, 1),
+            log_dict.get("lr", 0.0))
+
+    def validation_step_end(self, log_dict: dict) -> None:
+        speed = 1.0 / max(log_dict.get("eval_cost", 1e-9), 1e-9)
+        logger.info(
+            "[eval] step %d, batch: %d, loss: %.9f, avg_eval_cost: %.5f sec, "
+            "speed: %.2f step/s",
+            log_dict.get("global_step", 0), log_dict["batch"], log_dict["loss"],
+            log_dict.get("eval_cost", 0.0), speed)
+
+    @staticmethod
+    def model_size(num_layers: int, hidden_size: int, vocab_size: int) -> float:
+        """Parameter-count formula in billions (reference
+        ``language_module.py:102-105``)."""
+        return (num_layers * (12.0 * hidden_size * hidden_size)
+                + vocab_size * hidden_size) / 1e9
+
+
+class GPTModule(LanguageModule):
+    """GPT pretraining task (reference ``language_module.py:112-178``)."""
+
+    def __init__(self, cfg: Any):
+        from fleetx_tpu.models.gpt.model import config_from_dict
+
+        model_cfg = cfg.get("Model", cfg) if isinstance(cfg, dict) else cfg
+        self.model_cfg = config_from_dict(dict(model_cfg))
+        self.tokens_per_sample = self.model_cfg.max_position_embeddings
+        super().__init__(cfg)
+        logger.info(
+            "GPT model: layers=%d hidden=%d heads=%d vocab=%d (~%.2fB params)",
+            self.model_cfg.num_layers, self.model_cfg.hidden_size,
+            self.model_cfg.num_attention_heads, self.model_cfg.vocab_size,
+            self.model_size(self.model_cfg.num_layers, self.model_cfg.hidden_size,
+                            self.model_cfg.vocab_size))
+
+    def get_model(self):
+        from fleetx_tpu.models.gpt.model import GPTForPretraining
+
+        return GPTForPretraining(self.model_cfg)
+
+    def init_variables(self, rng: jax.Array, batch: dict) -> Any:
+        variables = self.model.init(
+            {"params": rng}, batch["tokens"][:1], batch["position_ids"][:1],
+            deterministic=True)
+        return variables["params"]
+
+    def training_loss(self, params, batch, rng, step):
+        from fleetx_tpu.models.gpt.model import cross_entropy_loss
+
+        dropout_rng = jax.random.fold_in(rng, step)
+        logits = self.model.apply(
+            {"params": params}, batch["tokens"], batch["position_ids"],
+            deterministic=False, rngs={"dropout": dropout_rng})
+        loss = cross_entropy_loss(logits, batch["labels"], batch["loss_mask"])
+        return loss, {"loss": loss}
+
+    def validation_loss(self, params, batch):
+        from fleetx_tpu.models.gpt.model import cross_entropy_loss
+
+        logits = self.model.apply(
+            {"params": params}, batch["tokens"], batch["position_ids"],
+            deterministic=True)
+        loss = cross_entropy_loss(logits, batch["labels"], batch["loss_mask"])
+        return loss, {"loss": loss}
+
+    def input_spec(self):
+        s = self.model_cfg.max_position_embeddings
+        return {
+            "tokens": jax.ShapeDtypeStruct((1, s), jnp.int32),
+            "position_ids": jax.ShapeDtypeStruct((1, s), jnp.int32),
+        }

@@ -161,7 +161,7 @@ class MultiHeadAttention(nn.Module):
         if cfg.use_flash_attention and (
                 cfg.attention_probs_dropout_prob == 0.0 or deterministic):
             from fleetx_tpu.ops import flash_attention
-            if flash_attention.supported(q):
+            if flash_attention.supported(q, k):
                 fn = partial(flash_attention.flash_attention, causal=True)
         if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
             fn = jax.checkpoint(fn)
@@ -307,15 +307,11 @@ class GPTModel(nn.Module):
                 layer_caches = {"key": cache.key, "value": cache.value,
                                 "index": jnp.broadcast_to(cache.index, (cfg.num_layers,))}
 
-            def body(block, x, lc):
-                x, nc = block(x, layer_cache=lc, deterministic=deterministic)
-                return x, nc
-
             stack = nn.scan(
                 layer,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(0,),
+                in_axes=(0, nn.broadcast),
                 out_axes=0,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

@@ -42,10 +42,13 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def supported(q: jax.Array, block_q: int = DEFAULT_BLOCK_Q,
-              block_k: int = DEFAULT_BLOCK_K) -> bool:
+def supported(q: jax.Array, k: jax.Array | None = None,
+              block_q: int = DEFAULT_BLOCK_Q,
+              block_k: int = DEFAULT_BLOCK_K, causal: bool = True) -> bool:
     """True when the pallas path applies: seq tiles into blocks and head_dim
-    is MXU-friendly."""
+    is MXU-friendly. When ``k`` is given, its seq length must also tile — and
+    must equal q's under ``causal`` (see flash_attention), so gating on this
+    predicate never selects a call that then raises."""
     if pltpu is None:
         return False
     if q.ndim != 4:
@@ -55,6 +58,14 @@ def supported(q: jax.Array, block_q: int = DEFAULT_BLOCK_Q,
         return False
     if seq < 128 or seq % 128:
         return False
+    if k is not None:
+        if k.ndim != 4 or k.shape[3] != head_dim:
+            return False
+        sk = k.shape[1]
+        if causal and sk != seq:
+            return False
+        if sk < 128 or sk % 128 or sk % min(sk, block_k):
+            return False
     return head_dim in (64, 128, 256)
 
 
@@ -309,6 +320,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Blockwise causal attention. q/k/v: [batch, seq, heads, head_dim]."""
     b, sq, n, d = q.shape
     sk = k.shape[1]
+    if causal and sq != sk:
+        # The kernel's causal mask compares absolute row/col positions with no
+        # offset, which is only meaningful for self-attention (sq == sk).
+        raise ValueError(
+            f"flash_attention(causal=True) requires q and k to share a seq "
+            f"length; got sq={sq}, sk={sk}")
     scale = scale if scale is not None else d ** -0.5
 
     def to3(x, s):

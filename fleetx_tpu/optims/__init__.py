@@ -1,0 +1,15 @@
+"""Optimizers and LR schedules (reference ``ppfleetx/optims/``)."""
+
+from fleetx_tpu.optims.lr_scheduler import (  # noqa: F401
+    build_lr_scheduler,
+    constant_lr,
+    cosine_annealing_with_warmup,
+    vit_lr,
+)
+from fleetx_tpu.optims.optimizer import (  # noqa: F401
+    adamw,
+    build_optimizer,
+    decay_mask,
+    is_no_decay_path,
+    sgd,
+)

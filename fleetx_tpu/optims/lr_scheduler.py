@@ -1,0 +1,106 @@
+"""Learning-rate schedules.
+
+Re-designs the reference schedulers (``ppfleetx/optims/lr_scheduler.py``) as
+pure step→lr functions (optax schedules): no mutable scheduler object, the
+schedule is traced into the jitted train step and the step counter lives in
+the optimizer state — which is what makes checkpoint/resume exact.
+
+- ``cosine_annealing_with_warmup``: Megatron schedule — linear warmup to
+  ``max_lr``, cosine decay to ``min_lr`` over ``decay_steps``, constant
+  ``min_lr`` after (reference ``lr_scheduler.py:134-162``).
+- ``vit_lr``: warmup + cosine or linear decay to zero over total steps
+  (reference ``ViTLRScheduler``, ``lr_scheduler.py:165-203``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cosine_annealing_with_warmup(max_lr: float, min_lr: float = 0.0,
+                                 warmup_steps: int = 0,
+                                 decay_steps: int = 1):
+    """Megatron cosine schedule (reference ``lr_scheduler.py:134-162``)."""
+    warmup_steps = int(warmup_steps)
+    decay_steps = max(int(decay_steps), 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = max_lr * step / jnp.maximum(warmup_steps, 1)
+        progress = jnp.clip((step - warmup_steps) / jnp.maximum(decay_steps - warmup_steps, 1),
+                            0.0, 1.0)
+        cosine = min_lr + 0.5 * (max_lr - min_lr) * (1.0 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_steps, warm, cosine)
+
+    return schedule
+
+
+def vit_lr(learning_rate: float, total_steps: int, warmup_steps: int = 0,
+           decay_type: str = "cosine", min_lr: float = 0.0):
+    """ViT warmup + cosine/linear decay (reference ``lr_scheduler.py:165-203``)."""
+    total_steps = max(int(total_steps), 1)
+    warmup_steps = int(warmup_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = learning_rate * step / jnp.maximum(warmup_steps, 1)
+        progress = jnp.clip((step - warmup_steps) / jnp.maximum(total_steps - warmup_steps, 1),
+                            0.0, 1.0)
+        if decay_type == "cosine":
+            decayed = min_lr + 0.5 * (learning_rate - min_lr) * (1.0 + jnp.cos(jnp.pi * progress))
+        elif decay_type == "linear":
+            decayed = learning_rate + (min_lr - learning_rate) * progress
+        else:
+            raise ValueError(f"unknown decay_type {decay_type!r}")
+        return jnp.where(step < warmup_steps, warm, decayed)
+
+    return schedule
+
+
+def constant_lr(learning_rate: float):
+    def schedule(step):
+        return jnp.full((), learning_rate, jnp.float32)
+
+    return schedule
+
+
+SCHEDULERS = {
+    "CosineAnnealingWithWarmupDecay": "cosine",
+    "cosine": "cosine",
+    "ViTLRScheduler": "vit",
+    "vit": "vit",
+    "constant": "constant",
+}
+
+
+def build_lr_scheduler(cfg: dict):
+    """Config-driven scheduler factory (reference ``optims/__init__.py:29-41``).
+
+    Accepts the reference's YAML keys: ``name``, ``max_lr``/``learning_rate``,
+    ``min_lr``, ``warmup_rate`` (fraction of decay_steps) or ``warmup_steps``,
+    ``decay_steps``.
+    """
+    cfg = dict(cfg or {})
+    name = SCHEDULERS.get(cfg.get("name", "cosine"))
+    if name is None:
+        raise ValueError(f"unknown lr scheduler {cfg.get('name')!r}")
+    if name == "constant":
+        return constant_lr(float(cfg.get("learning_rate", cfg.get("max_lr", 1e-4))))
+    if name == "vit":
+        return vit_lr(
+            learning_rate=float(cfg.get("learning_rate", 1e-3)),
+            total_steps=int(cfg.get("total_steps", cfg.get("decay_steps", 10000))),
+            warmup_steps=int(cfg.get("warmup_steps", 0)),
+            decay_type=cfg.get("decay_type", "cosine"),
+            min_lr=float(cfg.get("min_lr", 0.0)),
+        )
+    max_lr = float(cfg.get("max_lr", cfg.get("learning_rate", 1e-4)))
+    min_lr = float(cfg.get("min_lr", 0.0))
+    decay_steps = int(cfg.get("decay_steps", 10000))
+    if "warmup_steps" in cfg:
+        warmup_steps = int(cfg["warmup_steps"])
+    else:
+        warmup_steps = int(float(cfg.get("warmup_rate", 0.0)) * decay_steps)
+    return cosine_annealing_with_warmup(max_lr=max_lr, min_lr=min_lr,
+                                        warmup_steps=warmup_steps,
+                                        decay_steps=decay_steps)

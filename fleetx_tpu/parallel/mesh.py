@@ -77,7 +77,9 @@ def build_mesh(dist_config: dict | None = None, devices: list | None = None) -> 
     seq = int(cfg.get("seq_degree") or 1)
     mp = int(cfg.get("mp_degree") or 1)
     fixed = pp * fsdp * seq * mp
-    dp = int(cfg.get("dp_degree") or 0) or n // fixed
+    dp = int(cfg.get("dp_degree") or 0)
+    if dp <= 0:  # unset / 0 / -1 "derive" sentinel (matches process_dist_config)
+        dp = n // fixed
     shape = (pp, dp, fsdp, seq, mp)
     assert int(np.prod(shape)) == n, f"mesh shape {shape} != {n} devices"
     if n == 1:

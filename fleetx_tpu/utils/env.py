@@ -66,8 +66,17 @@ STREAMS = ("params", "dropout", "data", "sample")
 
 
 def rng_streams(root: jax.Array, names: tuple[str, ...] = STREAMS) -> dict[str, jax.Array]:
-    """Split the root key into named streams, stable under name ordering."""
-    return {name: jax.random.fold_in(root, i) for i, name in enumerate(names)}
+    """Split the root key into named streams, stable under name ordering.
+
+    Each stream key is derived by folding in a stable hash of the stream
+    *name* (not its position), so adding/reordering names never perturbs
+    existing streams — a reproducibility property the reference's stateful
+    per-rank seed trackers (``env.py:41-46``) cannot offer.
+    """
+    import zlib
+
+    return {name: jax.random.fold_in(root, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+            for name in names}
 
 
 def get_world_size() -> int:
