@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Any, Optional
 
 import jax
@@ -28,6 +29,16 @@ except ImportError:  # pragma: no cover
     ocp = None
 
 _META_NAME = "fleetx_meta.json"
+_checkpointer = None
+
+
+def _get_checkpointer():
+    """One shared StandardCheckpointer (its async machinery owns threads)."""
+    global _checkpointer
+    assert ocp is not None, "orbax-checkpoint is required for checkpointing"
+    if _checkpointer is None:
+        _checkpointer = ocp.StandardCheckpointer()
+    return _checkpointer
 
 
 def _step_dir(directory: str, step: int) -> str:
@@ -36,11 +47,18 @@ def _step_dir(directory: str, step: int) -> str:
 
 def save_checkpoint(directory: str, step: int, state: Any,
                     meta: Optional[dict] = None) -> str:
-    """Write a sharded checkpoint for ``step`` under ``directory``."""
-    assert ocp is not None, "orbax-checkpoint is required for checkpointing"
+    """Write a sharded checkpoint for ``step`` under ``directory``.
+
+    A step dir without its meta file is a half-written save (e.g. a
+    preemption between the state write and the meta write); it is removed
+    and overwritten rather than left to block every later save at this step.
+    """
     path = os.path.abspath(_step_dir(directory, step))
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "state"), state)
+    if os.path.isdir(path) and not os.path.exists(os.path.join(path, _META_NAME)):
+        logger.info("removing half-written checkpoint: %s", path)
+        shutil.rmtree(path)
+    ckptr = _get_checkpointer()
+    ckptr.save(os.path.join(path, "state"), state, force=True)
     ckptr.wait_until_finished()
     if jax.process_index() == 0:
         with open(os.path.join(path, _META_NAME), "w") as f:
@@ -72,9 +90,8 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any) -> tuple[Any
     ``sharding`` attributes (the engine builds it from its mesh) — Orbax loads
     each shard directly onto its destination devices.
     """
-    assert ocp is not None, "orbax-checkpoint is required for checkpointing"
     path = os.path.abspath(_step_dir(directory, step))
-    ckptr = ocp.StandardCheckpointer()
+    ckptr = _get_checkpointer()
     state = ckptr.restore(os.path.join(path, "state"), abstract_state)
     with open(os.path.join(path, _META_NAME)) as f:
         meta = json.load(f)

@@ -1,0 +1,2 @@
+from fleetx_tpu.core.engine.eager_engine import (  # noqa: F401
+    EagerEngine, TrainState, ScalerState, batch_sharding)

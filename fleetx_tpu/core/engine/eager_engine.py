@@ -1,0 +1,406 @@
+"""The trainer — reference ``EagerEngine`` re-designed for jit/GSPMD.
+
+Reference: ``ppfleetx/core/engine/eager_engine.py:41-738``. The reference
+engine imperatively wires AMP scalers, HCG process groups, sharded-model
+wrappers and a hand-rolled train loop. Here the same capabilities collapse
+into one jitted, mesh-sharded ``train_step``:
+
+- hybrid parallelism (dp/tp/fsdp/sp): the state's shardings are derived from
+  the model's logical axis metadata + one rule table
+  (``parallel/sharding.py``) — GSPMD inserts every collective the reference
+  hand-wires (``eager_engine.py:221-248`` wrap, ``385-399`` grad allreduce).
+- AMP: bf16 compute by default; optional fp16 dynamic loss scaling
+  (reference GradScaler, ``eager_engine.py:157-167``) implemented in-step.
+- grad accumulation (``accumulate_steps``): ``lax.scan`` over micro-batches
+  (reference splits local batch at ``utils/config.py:117``).
+- train loop semantics: max_steps / logging_freq / eval_freq / save_steps /
+  resume-skip (``eager_engine.py:250-330``) with the module's ips metric
+  hooks (``language_module.py:58-67``).
+
+Checkpointing is sharding-aware and topology-free (``core/checkpoint.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax import struct
+from flax.core import meta
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fleetx_tpu.core import checkpoint as ckpt_lib
+from fleetx_tpu.parallel.mesh import build_mesh
+from fleetx_tpu.parallel.sharding import make_axis_rules, zero_sharding
+from fleetx_tpu.utils.log import logger
+
+
+class ScalerState(struct.PyTreeNode):
+    """Dynamic fp16 loss-scale state (reference GradScaler config,
+    ``eager_engine.py:157-164``: init 32768, incr_every_n 1000, x2 / x0.5)."""
+
+    loss_scale: jax.Array     # f32 scalar
+    growth_tracker: jax.Array  # i32 consecutive-finite counter
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array            # i32 scalar
+    params: Any                # boxed (nn.Partitioned) param pytree
+    opt_state: Any
+    scaler: Optional[ScalerState] = None
+
+
+def _named_shardings(abstract_tree: Any, mesh: Mesh, rules) -> Any:
+    """Logical-annotation → NamedSharding tree (replicated where unboxed)."""
+    specs = nn.get_partition_spec(abstract_tree)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, nn.logical_to_mesh_axes(spec, rules)),
+        specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Global batches are sharded over the combined data axes (reference
+    ``env.get_data_world_size``: dp x sharding, ``utils/env.py:76-96``)."""
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
+class EagerEngine:
+    """Mesh-sharded trainer with the reference's loop semantics."""
+
+    def __init__(self, cfg: dict, module, optimizer=None, lr_schedule=None,
+                 mesh: Optional[Mesh] = None, mode: str = "train"):
+        self.cfg = cfg or {}
+        self.module = module
+        self.mode = mode
+
+        eng = dict(self.cfg.get("Engine") or {})
+        self.max_steps = int(eng.get("max_steps", 500000))
+        self.logging_freq = int(eng.get("logging_freq", 1))
+        self.eval_freq = int(eng.get("eval_freq", eng.get("eval_interval", 0) or 0))
+        self.eval_iters = int(eng.get("eval_iters", 10))
+        self.accumulate_steps = max(int(eng.get("accumulate_steps", 1) or 1), 1)
+        save_load = dict(eng.get("save_load") or {})
+        self.save_steps = int(save_load.get("save_steps", 0) or 0)
+        self.output_dir = save_load.get("output_dir", "./output")
+        self.ckpt_dir = save_load.get("ckpt_dir")
+
+        mp_cfg = dict(eng.get("mix_precision") or {})
+        self.use_fp16_scaler = bool(mp_cfg.get("use_pure_fp16")) and (
+            getattr(getattr(module, "model_cfg", None), "dtype", None) == jnp.float16)
+        self.init_loss_scale = float(mp_cfg.get("scale_loss", 32768.0))
+
+        dist = dict(self.cfg.get("Distributed") or {})
+        self.mesh = mesh if mesh is not None else build_mesh(dist)
+        self.rules = make_axis_rules(dist)
+        self.sharding_stage = int((dist.get("sharding") or {}).get("sharding_stage") or 0)
+
+        glb = dict(self.cfg.get("Global") or {})
+        self.seed = int(glb.get("seed", 1234))
+        self._base_rng = jax.random.PRNGKey(self.seed)
+
+        self.optimizer = optimizer
+        self.lr_schedule = lr_schedule
+        self.state: Optional[TrainState] = None
+        self.state_shardings = None
+        self._train_step = None
+        self._eval_step = None
+        self._consumed_samples = 0
+        self._start_epoch = 0
+
+    # ------------------------------------------------------------- contexts
+    def _ctx(self):
+        """Mesh + logical-rule context for every trace/execute."""
+        stack = contextlib.ExitStack()
+        stack.enter_context(self.mesh)
+        stack.enter_context(nn.logical_axis_rules(self.rules))
+        return stack
+
+    # ------------------------------------------------------- state creation
+    def _make_state_fn(self, sample_batch: dict):
+        module, optimizer = self.module, self.optimizer
+        use_scaler, init_scale = self.use_fp16_scaler, self.init_loss_scale
+
+        def make_state(rng):
+            params = module.init_variables(rng, sample_batch)
+            opt_state = optimizer.init(params) if optimizer is not None else ()
+            scaler = None
+            if use_scaler:
+                scaler = ScalerState(loss_scale=jnp.float32(init_scale),
+                                     growth_tracker=jnp.int32(0))
+            return TrainState(step=jnp.int32(0), params=params,
+                              opt_state=opt_state, scaler=scaler)
+
+        return make_state
+
+    def prepare(self, sample_batch: dict) -> TrainState:
+        """Initialise (or lazily re-use) the sharded train state."""
+        if self.state is not None:
+            return self.state
+        sample_batch = _host_batch(sample_batch)
+        with self._ctx():
+            make_state = self._make_state_fn(sample_batch)
+            abstract = jax.eval_shape(make_state, self._base_rng)
+            shardings = _named_shardings(abstract, self.mesh, self.rules)
+            if self.sharding_stage in (1, 2) and self.mesh.shape["fsdp"] > 1:
+                # ZeRO-1/2: shard optimizer moments over fsdp while params
+                # stay replicated (reference group_sharded_parallel
+                # level="os_g", eager_engine.py:228-242).
+                opt_abs = meta.unbox(abstract.opt_state)
+                opt_sh = _tree_of(shardings.opt_state)
+                shardings = shardings.replace(opt_state=zero_sharding(
+                    opt_abs, self.mesh, param_shardings=opt_sh))
+            self.state_shardings = shardings
+            init_fn = jax.jit(make_state, out_shardings=shardings)
+            t0 = time.time()
+            self.state = init_fn(self._base_rng)
+            jax.block_until_ready(jax.tree.leaves(self.state.params)[:1])
+            logger.info("initialized train state in %.1fs (%s params)",
+                        time.time() - t0,
+                        _fmt_count(_param_count(self.state.params)))
+        self._build_step_fns()
+        if self.ckpt_dir:
+            self.load(self.ckpt_dir)
+        return self.state
+
+    # ------------------------------------------------------------ step fns
+    def _build_step_fns(self):
+        module = self.module
+        optimizer, lr_schedule = self.optimizer, self.lr_schedule
+        accum = self.accumulate_steps
+        base_rng = self._base_rng
+        use_scaler = self.use_fp16_scaler
+
+        def grads_and_metrics(params, scaler, batch, step):
+            def loss_fn(p):
+                loss, metrics = module.training_loss(p, batch, base_rng, step)
+                if use_scaler:
+                    loss = loss * scaler.loss_scale.astype(loss.dtype)
+                return loss, metrics
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if use_scaler:
+                inv = 1.0 / scaler.loss_scale
+                grads = jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
+            return grads, metrics
+
+        def train_step(state: TrainState, batch: dict):
+            if accum > 1:
+                micro = jax.tree.map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+                    batch)
+
+                def body(carry, mb):
+                    g_acc, m_acc = carry
+                    g, m = grads_and_metrics(state.params, state.scaler, mb, state.step)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    m_acc = jax.tree.map(jnp.add, m_acc, m)
+                    return (g_acc, m_acc), None
+
+                g0 = jax.tree.map(jnp.zeros_like, state.params)
+                first = jax.tree.map(lambda x: x[0], micro)
+                g1, m1 = grads_and_metrics(state.params, state.scaler, first, state.step)
+                rest = jax.tree.map(lambda x: x[1:], micro)
+                (grads, metrics), _ = jax.lax.scan(body, (g1, m1), rest)
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                metrics = jax.tree.map(lambda m: m / accum, metrics)
+            else:
+                grads, metrics = grads_and_metrics(state.params, state.scaler,
+                                                   batch, state.step)
+
+            grad_norm = optax.global_norm(grads)
+            metrics = dict(metrics)
+            metrics["grad_norm"] = grad_norm
+            if lr_schedule is not None:
+                metrics["lr"] = lr_schedule(state.step)
+
+            updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+
+            new_scaler = state.scaler
+            if use_scaler:
+                finite = jnp.isfinite(grad_norm)
+                # skip the update on overflow; grow/backoff the scale
+                # (reference GradScaler semantics, eager_engine.py:157-164)
+                new_params = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old),
+                    new_params, state.params)
+                new_opt = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old) if
+                    getattr(new, "shape", None) == getattr(old, "shape", None)
+                    else new, new_opt, state.opt_state)
+                tracker = jnp.where(finite, state.scaler.growth_tracker + 1, 0)
+                grow = tracker >= 1000
+                scale = jnp.where(
+                    finite,
+                    jnp.where(grow, state.scaler.loss_scale * 2.0,
+                              state.scaler.loss_scale),
+                    state.scaler.loss_scale * 0.5)
+                new_scaler = ScalerState(loss_scale=scale,
+                                         growth_tracker=jnp.where(grow, 0, tracker))
+                metrics["loss_scale"] = scale
+
+            return TrainState(step=state.step + 1, params=new_params,
+                              opt_state=new_opt, scaler=new_scaler), metrics
+
+        def eval_step(state: TrainState, batch: dict):
+            loss, metrics = module.validation_loss(state.params, batch)
+            return dict(metrics)
+
+        bs = batch_sharding(self.mesh)
+        with self._ctx():
+            self._train_step = jax.jit(
+                train_step,
+                in_shardings=(self.state_shardings, bs),
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=(0,))
+            self._eval_step = jax.jit(
+                eval_step, in_shardings=(self.state_shardings, bs),
+                out_shardings=None)
+
+    def shard_batch(self, batch: dict) -> dict:
+        """Place a host batch onto the mesh, sharded over the data axes."""
+        bs = batch_sharding(self.mesh)
+        return jax.tree.map(lambda x: jax.device_put(np.asarray(x), bs), batch)
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, train_data_loader: Iterable, valid_data_loader=None,
+            epoch_num: int = 1):
+        """Train loop (reference ``fit``/``_train_one_epoch``,
+        ``eager_engine.py:250-381``)."""
+        it = iter(train_data_loader)
+        first = self.module.pretreating_batch(next(it))
+        self.prepare(first)
+
+        global_batch = _leading_dim(first)
+        start_step = int(jax.device_get(self.state.step))
+        if start_step >= self.max_steps:
+            logger.info("checkpoint already at step %d >= max_steps", start_step)
+            return
+
+        def batches():
+            yield first
+            for b in it:
+                yield self.module.pretreating_batch(b)
+            while True:  # re-iterate epochs over the same loader
+                got = False
+                for b in train_data_loader:
+                    got = True
+                    yield self.module.pretreating_batch(b)
+                if not got:  # one-shot iterator exhausted — stop cleanly
+                    return
+
+        with self._ctx():
+            t_last = time.time()
+            window = 0
+            losses = []
+            step = start_step  # host-side mirror of state.step (no per-step sync)
+            for batch in batches():
+                if step >= self.max_steps:
+                    break
+                sharded = self.shard_batch(batch)
+                self.state, metrics = self._train_step(self.state, sharded)
+                window += 1
+                self._consumed_samples += global_batch
+                step += 1
+                if window % self.logging_freq == 0:
+                    metrics = jax.device_get(metrics)
+                    now = time.time()
+                    cost = (now - t_last) / self.logging_freq
+                    t_last = now
+                    loss = float(metrics["loss"])
+                    losses.append(loss)
+                    self.module.training_step_end({
+                        "global_step": step, "epoch": 0, "batch": window,
+                        "loss": loss, "train_cost": cost,
+                        "global_batch_size": global_batch,
+                        "lr": float(metrics.get("lr", 0.0)),
+                    })
+                if self.eval_freq and valid_data_loader is not None and \
+                        step % self.eval_freq == 0:
+                    self.evaluate(valid_data_loader, global_step=step)
+                if self.save_steps and step % self.save_steps == 0:
+                    self.save()
+            return losses
+
+    # ---------------------------------------------------------------- eval
+    def evaluate(self, valid_data_loader: Iterable, global_step: int = 0):
+        """Eval loop (reference ``eager_engine.py:447-520``)."""
+        assert self.state is not None, "call prepare()/fit() first"
+        total, count = 0.0, 0
+        t0 = time.time()
+        with self._ctx():
+            for i, batch in enumerate(valid_data_loader):
+                if i >= self.eval_iters:
+                    break
+                batch = self.module.pretreating_batch(batch)
+                metrics = jax.device_get(
+                    self._eval_step(self.state, self.shard_batch(batch)))
+                total += float(metrics["loss"])
+                count += 1
+        if count:
+            self.module.validation_step_end({
+                "global_step": global_step, "batch": count,
+                "loss": total / count, "eval_cost": (time.time() - t0) / count,
+            })
+        return total / max(count, 1)
+
+    # ---------------------------------------------------------- checkpoints
+    def save(self):
+        """Save a resumable checkpoint (reference ``eager_engine.py:581-615``)."""
+        assert self.state is not None
+        step = int(jax.device_get(self.state.step))
+        return ckpt_lib.save_checkpoint(
+            self.output_dir, step, self.state,
+            meta={"consumed_samples": self._consumed_samples,
+                  "epoch": self._start_epoch, "seed": self.seed})
+
+    def load(self, directory: Optional[str] = None):
+        """Restore the latest checkpoint (reference ``eager_engine.py:617-660``)."""
+        directory = directory or self.output_dir
+        step = ckpt_lib.latest_step(directory)
+        if step is None:
+            logger.info("no checkpoint found under %s", directory)
+            return False
+        abstract = jax.tree.map(
+            lambda s, x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            self.state_shardings, meta.unbox(jax.eval_shape(lambda: self.state)))
+        state, meta_d = ckpt_lib.load_checkpoint(directory, step, abstract)
+        # re-box: restored leaves are raw arrays; re-attach logical metadata
+        self.state = jax.tree.map(
+            lambda box, leaf: box.replace_boxed(leaf) if isinstance(box, meta.AxisMetadata) else leaf,
+            jax.eval_shape(lambda: self.state), state,
+            is_leaf=lambda x: isinstance(x, meta.AxisMetadata))
+        self._consumed_samples = int(meta_d.get("consumed_samples", 0))
+        self._start_epoch = int(meta_d.get("epoch", 0))
+        return True
+
+
+# ------------------------------------------------------------------ helpers
+
+def _host_batch(batch: dict) -> dict:
+    return jax.tree.map(np.asarray, batch)
+
+
+def _leading_dim(batch: dict) -> int:
+    return int(jax.tree.leaves(batch)[0].shape[0])
+
+
+def _tree_of(tree: Any) -> Any:
+    return tree
+
+
+def _param_count(params: Any) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(meta.unbox(params)))
+
+
+def _fmt_count(n: int) -> str:
+    if n >= 1e9:
+        return f"{n / 1e9:.2f}B"
+    if n >= 1e6:
+        return f"{n / 1e6:.1f}M"
+    return str(n)

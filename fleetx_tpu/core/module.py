@@ -10,8 +10,7 @@ mesh. Host-side hooks (``training_step_end`` logging etc.) stay imperative.
 
 from __future__ import annotations
 
-import time
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -139,20 +138,22 @@ class GPTModule(LanguageModule):
         return variables["params"]
 
     def training_loss(self, params, batch, rng, step):
+        from flax.core import meta
         from fleetx_tpu.models.gpt.model import cross_entropy_loss
 
         dropout_rng = jax.random.fold_in(rng, step)
         logits = self.model.apply(
-            {"params": params}, batch["tokens"], batch["position_ids"],
+            {"params": meta.unbox(params)}, batch["tokens"], batch["position_ids"],
             deterministic=False, rngs={"dropout": dropout_rng})
         loss = cross_entropy_loss(logits, batch["labels"], batch["loss_mask"])
         return loss, {"loss": loss}
 
     def validation_loss(self, params, batch):
+        from flax.core import meta
         from fleetx_tpu.models.gpt.model import cross_entropy_loss
 
         logits = self.model.apply(
-            {"params": params}, batch["tokens"], batch["position_ids"],
+            {"params": meta.unbox(params)}, batch["tokens"], batch["position_ids"],
             deterministic=True)
         loss = cross_entropy_loss(logits, batch["labels"], batch["loss_mask"])
         return loss, {"loss": loss}

@@ -17,9 +17,11 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import optax
 
-NO_DECAY_TOKENS = ("bias", "norm", "ln_", "ln1", "ln2", "ln_f", "layernorm")
+NO_DECAY_SUBSTRINGS = ("bias", "norm", "layernorm")
+NO_DECAY_EXACT = ("ln", "ln1", "ln2", "ln_f")
 
 
 def is_no_decay_path(path: tuple) -> bool:
@@ -31,9 +33,7 @@ def is_no_decay_path(path: tuple) -> bool:
     """
     keys = [getattr(p, "key", getattr(p, "name", str(p))).lower() for p in path]
     for k in keys:
-        if "bias" in k:
-            return True
-        if any(tok in k for tok in ("norm", "ln_f", "layernorm")) or k in ("ln1", "ln2", "ln"):
+        if any(tok in k for tok in NO_DECAY_SUBSTRINGS) or k in NO_DECAY_EXACT:
             return True
     return False
 
@@ -61,7 +61,7 @@ def adamw(learning_rate, *, beta1: float = 0.9, beta2: float = 0.999,
         chain.append(optax.clip_by_global_norm(grad_clip))
     chain.append(optax.scale_by_adam(
         b1=beta1, b2=beta2, eps=epsilon,
-        mu_dtype=None if multi_precision else None))
+        mu_dtype=jnp.float32 if multi_precision else None))
     if weight_decay:
         chain.append(optax.add_decayed_weights(weight_decay, mask=decay_mask))
     chain.append(optax.scale_by_learning_rate(learning_rate))

@@ -1,0 +1,155 @@
+"""Engine end-to-end: sharded train loop, loss decrease, dp/tp/fsdp parity.
+
+This is the multi-device correctness evidence the reference never had
+(SURVEY.md §4): the same tiny GPT trained on a 1-device mesh and an 8-device
+dp×tensor×fsdp mesh must produce the same loss sequence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fleetx_tpu.core.engine import EagerEngine
+from fleetx_tpu.core.module import GPTModule
+from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+from fleetx_tpu.optims.optimizer import build_optimizer
+from fleetx_tpu.parallel.mesh import build_mesh
+
+VOCAB = 128
+SEQ = 32
+BATCH = 8
+
+
+def tiny_cfg(**model_overrides):
+    model = dict(
+        vocab_size=VOCAB, hidden_size=64, num_layers=2, num_attention_heads=4,
+        max_position_embeddings=SEQ, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, use_flash_attention=False,
+        dtype="float32", param_dtype="float32")
+    model.update(model_overrides)
+    return {
+        "Model": model,
+        "Engine": {"max_steps": 5, "logging_freq": 1, "eval_freq": 0},
+        "Global": {"seed": 7},
+    }
+
+
+def make_batches(n, seed=0, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        tokens = rng.randint(0, VOCAB, size=(batch, SEQ)).astype(np.int32)
+        out.append({
+            "tokens": tokens,
+            "position_ids": np.broadcast_to(np.arange(SEQ, dtype=np.int32),
+                                            (batch, SEQ)).copy(),
+            "labels": rng.randint(0, VOCAB, size=(batch, SEQ)).astype(np.int32),
+            "loss_mask": np.ones((batch, SEQ), np.float32),
+        })
+    return out
+
+
+def build_engine(cfg, mesh, max_lr=1e-3):
+    module = GPTModule(cfg)
+    lr = build_lr_scheduler({"name": "cosine", "max_lr": max_lr, "min_lr": 1e-4,
+                             "warmup_steps": 2, "decay_steps": 100})
+    opt = build_optimizer({"name": "AdamW", "weight_decay": 0.01,
+                           "grad_clip": {"clip_norm": 1.0}}, lr)
+    return EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr, mesh=mesh)
+
+
+def run_losses(cfg, mesh, n_steps, seed=0):
+    eng = build_engine(cfg, mesh)
+    cfg["Engine"]["max_steps"] = n_steps
+    eng.max_steps = n_steps
+    return eng.fit(make_batches(n_steps, seed=seed))
+
+
+def test_train_loss_starts_at_log_vocab_and_decreases(devices8):
+    mesh = build_mesh({}, devices=devices8[:1])
+    eng = build_engine(tiny_cfg(), mesh)
+    eng.max_steps = 8
+    # one learnable batch repeated: loss must fall as the model memorizes it
+    b = make_batches(1, seed=3)[0]
+    b["labels"] = np.roll(b["tokens"], -1, axis=1)
+    losses = eng.fit([b] * 8)
+    assert len(losses) == 8
+    # untrained model ≈ uniform over vocab: first loss ~ log(VOCAB)
+    assert abs(losses[0] - np.log(VOCAB)) < 0.5, losses
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_sharded_loss_parity_dp_tp_fsdp(devices8):
+    """dp2 × tensor2 × fsdp2 must reproduce the single-device loss curve."""
+    cfg = tiny_cfg()
+    mesh1 = build_mesh({}, devices=devices8[:1])
+    ref = run_losses(cfg, mesh1, 4)
+
+    cfg8 = tiny_cfg()
+    cfg8["Distributed"] = {"dp_degree": 2, "mp_degree": 2, "fsdp_degree": 2}
+    mesh8 = build_mesh(cfg8["Distributed"], devices=devices8)
+    got = run_losses(cfg8, mesh8, 4)
+
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_loss_parity_sequence_parallel(devices8):
+    """Megatron-SP (act_seq over tensor axis) keeps loss parity."""
+    cfg = tiny_cfg()
+    mesh1 = build_mesh({}, devices=devices8[:1])
+    ref = run_losses(cfg, mesh1, 3)
+
+    cfg_sp = tiny_cfg(sequence_parallel=True)
+    cfg_sp["Distributed"] = {"mp_degree": 4, "dp_degree": 2,
+                             "sequence_parallel": True}
+    mesh8 = build_mesh(cfg_sp["Distributed"], devices=devices8)
+    got = run_losses(cfg_sp, mesh8, 3)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_zero_stage2_shards_optimizer_state(devices8):
+    cfg = tiny_cfg()
+    cfg["Distributed"] = {"fsdp_degree": 4, "dp_degree": 2,
+                          "sharding": {"sharding_stage": 2}}
+    mesh = build_mesh(cfg["Distributed"], devices=devices8)
+    eng = build_engine(cfg, mesh)
+    eng.prepare(make_batches(1)[0])
+
+    def spec_axes(arr):
+        axes = set()
+        for entry in arr.sharding.spec:
+            if isinstance(entry, (tuple, list)):
+                axes.update(entry)
+            elif entry is not None:
+                axes.add(entry)
+        return axes
+
+    opt_axes = [spec_axes(l) for l in jax.tree.leaves(eng.state.opt_state)]
+    assert any("fsdp" in a for a in opt_axes), \
+        f"no optimizer-state leaf sharded over fsdp: {opt_axes}"
+    # params stay replicated at stage 2 (no fsdp in their specs)
+    for leaf in jax.tree.leaves(eng.state.params):
+        assert "fsdp" not in spec_axes(leaf)
+
+
+def test_grad_accumulation_matches_big_batch(devices8):
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg_a = tiny_cfg()
+    ref = run_losses(cfg_a, mesh, 3)
+
+    cfg_b = tiny_cfg()
+    cfg_b["Engine"]["accumulate_steps"] = 4
+    got = run_losses(cfg_b, mesh, 3)
+    # average-of-micro-losses == big-batch loss for the mean CE with equal
+    # masks; allow small fp reassociation slack
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fp16_scaler_runs_and_is_finite(devices8):
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg(dtype="float16")
+    cfg["Engine"]["mix_precision"] = {"use_pure_fp16": True, "scale_loss": 1024}
+    losses = run_losses(cfg, mesh, 3)
+    assert all(np.isfinite(losses))

@@ -44,4 +44,4 @@ def test_sharded_matmul_runs(devices8):
     xs = jax.device_put(x, NamedSharding(mesh, P(("data", "fsdp"), None)))
     ws = jax.device_put(w, NamedSharding(mesh, P(None, "tensor")))
     y = jax.jit(jnp.dot)(xs, ws)
-    np.testing.assert_allclose(np.asarray(y), x @ w, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y), x @ w, rtol=1e-4, atol=1e-5)
