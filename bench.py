@@ -1,0 +1,110 @@
+"""Benchmark: GPT-345M pretraining throughput on the attached accelerator.
+
+Baseline (BASELINE.md): the reference's only published single-card number —
+GPT-345M, fp16 O2, seq_len 1024, local_bs 8 → ~16,200 tokens/s on 1x V100-32G
+(``/root/reference/docs/quick_start.md:112-116``). ``vs_baseline`` is the
+ratio of our measured tokens/s to that bar.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_S = 16200.0
+BATCH = 8
+SEQ = 1024
+
+
+def _check_flash_numerics():
+    """Compiled Pallas flash attention vs naive attention, on this backend."""
+    import jax
+    import jax.numpy as jnp
+    from fleetx_tpu.ops import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    shape = (2, 512, 8, 64)
+    q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    if not fa.supported(q, k):
+        return "flash-unsupported"
+    out = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))(q, k, v)
+    ref = jax.jit(lambda q, k, v: fa.reference_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=True))(q, k, v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 2e-2, f"flash attention numerics off on-chip: max err {err}"
+    return f"flash-ok(err={err:.1e})"
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    flash_status = _check_flash_numerics()
+
+    from fleetx_tpu.core.engine import EagerEngine
+    from fleetx_tpu.core.module import GPTModule
+    from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+    from fleetx_tpu.optims.optimizer import build_optimizer
+
+    cfg = {
+        "Model": dict(vocab_size=50304, hidden_size=1024, num_layers=24,
+                      num_attention_heads=16, ffn_hidden_size=4096,
+                      max_position_embeddings=SEQ),
+        "Engine": {"max_steps": 10_000, "logging_freq": 100},
+        "Global": {"seed": 0},
+    }
+    module = GPTModule(cfg)
+    lr = build_lr_scheduler({"max_lr": 3e-4, "warmup_steps": 100,
+                             "decay_steps": 1000})
+    opt = build_optimizer({"name": "AdamW"}, lr)
+    engine = EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr)
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 50304, size=(BATCH, SEQ + 1)).astype(np.int32)
+    batch = {
+        "tokens": tokens[:, :-1],
+        "position_ids": np.broadcast_to(
+            np.arange(SEQ, dtype=np.int32), (BATCH, SEQ)).copy(),
+        "labels": tokens[:, 1:],
+        "loss_mask": np.ones((BATCH, SEQ), np.float32),
+    }
+
+    engine.prepare(batch)
+    sharded = engine.shard_batch(batch)
+    with engine._ctx():
+        # warmup (compile + first steps)
+        for _ in range(3):
+            engine.state, metrics = engine._train_step(engine.state, sharded)
+        jax.block_until_ready(metrics["loss"])
+
+        n_steps = 10
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            engine.state, metrics = engine._train_step(engine.state, sharded)
+        loss = float(jax.block_until_ready(metrics["loss"]))
+        dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_s = BATCH * SEQ / dt
+    result = {
+        "metric": f"gpt345m_train_tokens_per_s_{platform}",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_s / BASELINE_TOKENS_PER_S, 3),
+        "step_time_s": round(dt, 4),
+        "loss": round(loss, 3),
+        "flash": flash_status,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,17 @@ def latest_step(directory: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def peek_meta(directory: str) -> Optional[dict]:
+    """Read the latest checkpoint's meta dict without touching array data —
+    used by the CLI to seed the sampler's ``consumed_samples`` before the
+    engine restores the full state."""
+    step = latest_step(directory)
+    if step is None:
+        return None
+    with open(os.path.join(_step_dir(directory, step), _META_NAME)) as f:
+        return json.load(f)
+
+
 def load_checkpoint(directory: str, step: int, abstract_state: Any) -> tuple[Any, dict]:
     """Restore a checkpoint, re-sharding to ``abstract_state``'s shardings.
 

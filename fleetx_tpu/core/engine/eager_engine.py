@@ -79,14 +79,18 @@ class EagerEngine:
         self.module = module
         self.mode = mode
 
+        def _int(section, key, default):
+            v = section.get(key, default)
+            return default if v is None else int(v)
+
         eng = dict(self.cfg.get("Engine") or {})
-        self.max_steps = int(eng.get("max_steps", 500000))
-        self.logging_freq = int(eng.get("logging_freq", 1))
-        self.eval_freq = int(eng.get("eval_freq", eng.get("eval_interval", 0) or 0))
-        self.eval_iters = int(eng.get("eval_iters", 10))
-        self.accumulate_steps = max(int(eng.get("accumulate_steps", 1) or 1), 1)
+        self.max_steps = _int(eng, "max_steps", 500000)
+        self.logging_freq = _int(eng, "logging_freq", 1)
+        self.eval_freq = _int(eng, "eval_freq", 0)
+        self.eval_iters = _int(eng, "eval_iters", 10)
+        self.accumulate_steps = max(_int(eng, "accumulate_steps", 1), 1)
         save_load = dict(eng.get("save_load") or {})
-        self.save_steps = int(save_load.get("save_steps", 0) or 0)
+        self.save_steps = _int(save_load, "save_steps", 0)
         self.output_dir = save_load.get("output_dir", "./output")
         self.ckpt_dir = save_load.get("ckpt_dir")
 
@@ -253,11 +257,12 @@ class EagerEngine:
 
         bs = batch_sharding(self.mesh)
         with self._ctx():
-            self._train_step = jax.jit(
-                train_step,
-                in_shardings=(self.state_shardings, bs),
-                out_shardings=(self.state_shardings, None),
-                donate_argnums=(0,))
+            if optimizer is not None:
+                self._train_step = jax.jit(
+                    train_step,
+                    in_shardings=(self.state_shardings, bs),
+                    out_shardings=(self.state_shardings, None),
+                    donate_argnums=(0,))
             self._eval_step = jax.jit(
                 eval_step, in_shardings=(self.state_shardings, bs),
                 out_shardings=None)
@@ -276,7 +281,9 @@ class EagerEngine:
         first = self.module.pretreating_batch(next(it))
         self.prepare(first)
 
-        global_batch = _leading_dim(first)
+        # consumed_samples counts GLOBAL samples (the sampler's unit): the
+        # per-host leading dim times the number of hosts
+        global_batch = _leading_dim(first) * jax.process_count()
         start_step = int(jax.device_get(self.state.step))
         if start_step >= self.max_steps:
             logger.info("checkpoint already at step %d >= max_steps", start_step)
@@ -354,8 +361,10 @@ class EagerEngine:
         """Save a resumable checkpoint (reference ``eager_engine.py:581-615``)."""
         assert self.state is not None
         step = int(jax.device_get(self.state.step))
+        # store the UNboxed tree: partition metadata lives in code, not in the
+        # checkpoint, so restores re-shard freely onto any mesh
         return ckpt_lib.save_checkpoint(
-            self.output_dir, step, self.state,
+            self.output_dir, step, meta.unbox(self.state),
             meta={"consumed_samples": self._consumed_samples,
                   "epoch": self._start_epoch, "seed": self.seed})
 

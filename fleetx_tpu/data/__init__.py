@@ -1,0 +1,67 @@
+"""Config-driven data builders (reference ``ppfleetx/data/__init__.py:25-73``).
+
+The reference resolves dataset/sampler/collate classes with ``eval()`` over
+config strings; here an explicit registry does the same without arbitrary
+code execution.
+"""
+
+from __future__ import annotations
+
+from fleetx_tpu.data.dataloader import DataLoader, default_collate
+from fleetx_tpu.data.dataset.gpt_dataset import (
+    GPTDataset, SyntheticGPTDataset, write_corpus)
+from fleetx_tpu.data.sampler.batch_sampler import (
+    DistributedBatchSampler, GPTBatchSampler)
+
+DATASETS = {"GPTDataset": GPTDataset,
+            "SyntheticGPTDataset": SyntheticGPTDataset}
+SAMPLERS = {"GPTBatchSampler": GPTBatchSampler,
+            "DistributedBatchSampler": DistributedBatchSampler}
+
+__all__ = ["DataLoader", "default_collate", "GPTDataset", "write_corpus",
+           "DistributedBatchSampler", "GPTBatchSampler",
+           "build_dataset", "build_dataloader"]
+
+
+def build_dataset(cfg: dict, mode: str = "Train", **overrides):
+    """Build a dataset from a config ``Data.{mode}.dataset`` section."""
+    section = dict((cfg.get(mode) or cfg).get("dataset") or {})
+    name = section.pop("name", "GPTDataset")
+    cls = DATASETS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown dataset {name!r}")
+    section.pop("split", None)  # handled by callers building per-split sets
+    section.update(overrides)
+    input_dir = section.pop("input_dir", None)
+    if input_dir is not None and "data_prefix" not in section:
+        section["data_prefix"] = input_dir
+    section.setdefault("seq_length", section.pop("max_seq_len", 1024))
+    return cls(**section)
+
+
+def build_dataloader(cfg: dict, mode: str = "Train", *,
+                     num_replicas: int = 1, rank: int = 0,
+                     consumed_samples: int = 0, **dataset_overrides):
+    """Dataset + sampler + loader from a config ``Data.{mode}`` section
+    (reference ``build_dataloader``, ``data/__init__.py:42-73``)."""
+    section = dict(cfg.get(mode) or cfg)
+    dataset = build_dataset(cfg, mode, **dataset_overrides)
+    sampler_cfg = dict(section.get("sampler") or {})
+    name = sampler_cfg.pop("name",
+                           "GPTBatchSampler" if mode == "Train"
+                           else "DistributedBatchSampler")
+    loader_cfg = dict(section.get("loader") or {})
+    batch_size = int(loader_cfg.get("batch_size",
+                                    sampler_cfg.pop("batch_size", 1)))
+    kwargs = dict(num_replicas=num_replicas, rank=rank,
+                  drop_last=bool(sampler_cfg.pop("drop_last", True)))
+    if name == "GPTBatchSampler":
+        kwargs["consumed_samples"] = consumed_samples
+    else:
+        kwargs["shuffle"] = bool(sampler_cfg.pop("shuffle", False))
+    # forward remaining sampler keys (seed, ...) so nothing is swallowed;
+    # unknown keys fail fast in the sampler constructor
+    kwargs.update(sampler_cfg)
+    sampler = SAMPLERS[name](len(dataset), batch_size, **kwargs)
+    return DataLoader(dataset, sampler,
+                      prefetch=int(loader_cfg.get("prefetch", 2)))

@@ -1,0 +1,70 @@
+"""Minimal host-side data loader: sampler → collated numpy batches.
+
+The reference leans on ``paddle.io.DataLoader`` worker processes; on TPU the
+input pipeline is host-side numpy feeding a device-sharded ``device_put``
+(``EagerEngine.shard_batch``), so a thin prefetching iterator suffices —
+XLA overlaps the host work with device steps via async dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import queue as queue_mod
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+
+def default_collate(samples: list) -> dict:
+    """Stack dict-of-array samples into a batch (reference ``Stack`` collate,
+    ``data/sampler/collate.py:27``)."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack(col) for col in zip(*samples))
+    return np.stack(samples)
+
+
+class DataLoader:
+    """Iterates a batch sampler over a dataset, collating to numpy.
+
+    ``prefetch`` > 0 runs assembly in a background thread so host batch
+    construction overlaps device execution.
+    """
+
+    def __init__(self, dataset, batch_sampler: Iterable,
+                 collate_fn: Optional[Callable] = None, prefetch: int = 2):
+        self.dataset = dataset
+        self.batch_sampler = batch_sampler
+        self.collate_fn = collate_fn or default_collate
+        self.prefetch = int(prefetch)
+
+    def _make(self, indices) -> dict:
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.prefetch <= 0:
+            for indices in self.batch_sampler:
+                yield self._make(indices)
+            return
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self.prefetch)
+        sentinel = object()
+
+        def producer():
+            try:
+                for indices in self.batch_sampler:
+                    q.put(self._make(indices))
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+
+    def __len__(self) -> int:
+        return len(self.batch_sampler)

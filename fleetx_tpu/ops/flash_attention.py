@@ -42,6 +42,13 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def dropout_supported() -> bool:
+    """In-kernel dropout needs the TPU PRNG (``pltpu.prng_seed``), which has
+    no interpret-mode lowering — so it's available exactly when we're NOT
+    interpreting. CPU callers fall back to the naive-attention dropout path."""
+    return pltpu is not None and not _interpret()
+
+
 def supported(q: jax.Array, k: jax.Array | None = None,
               block_q: int = DEFAULT_BLOCK_Q,
               block_k: int = DEFAULT_BLOCK_K, causal: bool = True) -> bool:
@@ -74,9 +81,31 @@ def supported(q: jax.Array, k: jax.Array | None = None,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _dropout_mask(seed_ref, h, qi, kj, nq_blocks, nk_blocks, shape, rate: float):
+    """Regenerable per-block dropout mask (same seeding in fwd and bwd).
+
+    Seeded by (step seed, flat block coordinates) so the backward kernels
+    reproduce the identical mask when they recompute P from the logsumexp —
+    this is what lets attention dropout run inside the flash kernel instead
+    of materialising [S, S] probability/mask tensors (the reference applies
+    dropout to full probs, ``single_model.py:214``).
+
+    ``nq_blocks``/``nk_blocks`` are STATIC so the flat id is identical across
+    the fwd/dq/dkv kernels, whose grid orders differ; Mosaic accepts at most
+    two seed words.
+    """
+    flat = (h * nq_blocks * nk_blocks + qi * nk_blocks + kj).astype(jnp.int32)
+    pltpu.prng_seed(seed_ref[0], flat)
+    bits = pltpu.prng_random_bits(shape)
+    threshold = min(int(rate * 2.0 ** 32), 2 ** 32 - 1)
+    keep = bits.astype(jnp.uint32) >= jnp.uint32(threshold)
+    return keep
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
-                block_q: int, block_k: int):
+                block_q: int, block_k: int, dropout_rate: float):
+    h = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -108,8 +137,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, s.max(axis=1))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
+        # the softmax normaliser uses UNdropped p; the mask scales only the
+        # weighted sum, so out = mask .* softmax(s) / keep_prob @ v
         l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=1)
         m_ref[:, 0] = m_new
+        if dropout_rate > 0.0:
+            keep = _dropout_mask(seed_ref, h, qi, kj, pl.num_programs(1),
+                                 pl.num_programs(2), p.shape, dropout_rate)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         v = v_ref[0].astype(jnp.float32)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot(
             p, v, preferred_element_type=jnp.float32)
@@ -119,10 +154,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:, 0]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(l_safe)
+        # lse laid out [bn, sq, 1]: Mosaic needs the last two block dims
+        # (8k, 128m-or-full); a (block_q, 1) store satisfies that where a
+        # 2D (1, block_q) block does not.
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe))[:, None]
 
 
-def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k):
+def _fwd(q3, k3, v3, seed, *, scale, causal, block_q, block_k, dropout_rate):
     bn, sq, d = q3.shape
     sk = k3.shape[1]
     block_q = min(block_q, sq)
@@ -130,20 +168,22 @@ def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k):
     grid = (bn, sq // block_q, sk // block_k)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          dropout_rate=dropout_rate),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bn, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bn, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bn, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             _VMEM((block_q, d), jnp.float32),
@@ -151,8 +191,8 @@ def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k):
             _VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3)
-    return out, lse
+    )(q3, k3, v3, seed)
+    return out, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -160,8 +200,10 @@ def _fwd(q3, k3, v3, *, scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+                   dq_ref, acc_ref, *, scale, causal, block_q, block_k,
+                   dropout_rate):
+    h = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -188,10 +230,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0])          # lse block [bq, 1] broadcasts
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        if dropout_rate > 0.0:
+            keep = _dropout_mask(seed_ref, h, qi, kj, pl.num_programs(1),
+                                 pl.num_programs(2), p.shape, dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta_ref[0]) * scale
         acc_ref[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(kj == nk - 1)
@@ -199,9 +245,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k):
+                    block_q, block_k, dropout_rate):
+    h = pl.program_id(0)
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -229,12 +276,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])  # [bq, bk]
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse_ref[0])  # [bq, bk]; lse block [bq, 1] broadcasts
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        if dropout_rate > 0.0:
+            # identical (h, qi, kj) seeding as the forward mask; this kernel's
+            # grid is (h, kj, qi) so the q/k block counts swap positions
+            keep = _dropout_mask(seed_ref, h, qi, kj, pl.num_programs(2),
+                                 pl.num_programs(1), p.shape, dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            dv_acc[:] += jax.lax.dot_general(
+                jnp.where(keep, p * inv, 0.0), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -244,44 +302,49 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, residuals, g):
-    q3, k3, v3, out, lse = residuals
+def _bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
+    q3, k3, v3, seed, out, lse = residuals
     do = g
     bn, sq, d = q3.shape
     sk = k3.shape[1]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     delta = (out.astype(jnp.float32) * do.astype(jnp.float32)).sum(axis=-1)
+    # lse/delta travel as [bn, sq, 1] so their blocks tile on TPU (see _fwd)
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, dropout_rate=dropout_rate),
         grid=(bn, sq // bq, sk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
             pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
             pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
             pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, bq), lambda h, i, j: (h, i)),
-            pl.BlockSpec((1, bq), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bn, sq, d), q3.dtype),
         scratch_shapes=[_VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, do, lse, delta)
+    )(q3, k3, v3, do, lse3, delta3, seed)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, dropout_rate=dropout_rate),
         grid=(bn, sk // bk, sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
             pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
             pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
             pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
-            pl.BlockSpec((1, bq), lambda h, j, i: (h, i)),
-            pl.BlockSpec((1, bq), lambda h, j, i: (h, i)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
@@ -293,21 +356,21 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
         ],
         scratch_shapes=[_VMEM((bk, d), jnp.float32), _VMEM((bk, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, do, lse, delta)
-    return dq, dk, dv
+    )(q3, k3, v3, do, lse3, delta3, seed)
+    return dq, dk, dv, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash3(q3, k3, v3, scale, causal, block_q, block_k):
-    out, _ = _fwd(q3, k3, v3, scale=scale, causal=causal,
-                  block_q=block_q, block_k=block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash3(q3, k3, v3, seed, scale, causal, block_q, block_k, dropout_rate):
+    out, _ = _fwd(q3, k3, v3, seed, scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
     return out
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k):
-    out, lse = _fwd(q3, k3, v3, scale=scale, causal=causal,
-                    block_q=block_q, block_k=block_k)
-    return out, (q3, k3, v3, out, lse)
+def _flash3_fwd(q3, k3, v3, seed, scale, causal, block_q, block_k, dropout_rate):
+    out, lse = _fwd(q3, k3, v3, seed, scale=scale, causal=causal,
+                    block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
+    return out, (q3, k3, v3, seed, out, lse)
 
 
 _flash3.defvjp(_flash3_fwd, _bwd)
@@ -316,8 +379,16 @@ _flash3.defvjp(_flash3_fwd, _bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: float | None = None,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
-    """Blockwise causal attention. q/k/v: [batch, seq, heads, head_dim]."""
+                    block_k: int = DEFAULT_BLOCK_K,
+                    dropout_rate: float = 0.0,
+                    dropout_seed: jax.Array | None = None) -> jax.Array:
+    """Blockwise causal attention. q/k/v: [batch, seq, heads, head_dim].
+
+    ``dropout_rate`` > 0 applies attention-probability dropout INSIDE the
+    kernel (regenerable per-block masks; see ``_dropout_mask``) so training
+    configs with attention dropout keep the O(S) memory profile.
+    ``dropout_seed``: int32 scalar/[1] array; vary per step.
+    """
     b, sq, n, d = q.shape
     sk = k.shape[1]
     if causal and sq != sk:
@@ -327,12 +398,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             f"flash_attention(causal=True) requires q and k to share a seq "
             f"length; got sq={sq}, sk={sk}")
     scale = scale if scale is not None else d ** -0.5
+    if dropout_seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
 
     def to3(x, s):
         return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
 
-    out3 = _flash3(to3(q, sq), to3(k, sk), to3(v, sk), scale, causal,
-                   block_q, block_k)
+    out3 = _flash3(to3(q, sq), to3(k, sk), to3(v, sk), seed, scale, causal,
+                   block_q, block_k, float(dropout_rate))
     return out3.reshape(b, n, sq, d).transpose(0, 2, 1, 3)
 
 

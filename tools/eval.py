@@ -1,0 +1,38 @@
+"""Offline evaluation entry point (reference ``tools/eval.py:106-126``)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from fleetx_tpu.core.engine import EagerEngine
+from fleetx_tpu.data import build_dataloader
+from fleetx_tpu.models import build_module
+from fleetx_tpu.optims import build_lr_scheduler, build_optimizer
+from fleetx_tpu.parallel.mesh import build_mesh, set_mesh
+from fleetx_tpu.utils import config as config_mod
+from fleetx_tpu.utils import env as env_mod
+
+
+def main():
+    args = config_mod.parse_args("fleetx_tpu eval")
+    env_mod.init_dist_env()
+    cfg = config_mod.get_config(args.config, args.override, show=True)
+
+    mesh = set_mesh(build_mesh(cfg.get("Distributed")))
+    module = build_module(cfg)
+    engine = EagerEngine(cfg, module, mesh=mesh, mode="eval")
+
+    n_proc = jax.process_count()
+    eval_dl = build_dataloader(cfg.get("Data") or {}, "Eval",
+                               num_replicas=n_proc, rank=jax.process_index())
+    first = next(iter(eval_dl))
+    engine.prepare(first)
+    loss = engine.evaluate(eval_dl)
+    print(f"eval loss: {loss:.6f}")
+
+
+if __name__ == "__main__":
+    main()
