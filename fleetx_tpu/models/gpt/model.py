@@ -76,11 +76,17 @@ def _dense_init(cfg: GPTConfig):
 
 @struct.dataclass
 class DecodeCache:
-    """KV cache for autoregressive decode (reference Cache, ``single_model.py:77``)."""
+    """KV cache for autoregressive decode (reference Cache, ``single_model.py:77``).
+
+    ``mask`` records which cached key positions are valid — left-padded
+    prompt positions stay masked forever (reference left-pad handling,
+    ``language_module.py:221-243``).
+    """
 
     key: jax.Array    # [layers, batch, max_len, heads, head_dim]
     value: jax.Array  # [layers, batch, max_len, heads, head_dim]
     index: jax.Array  # [] int32 — number of tokens already cached
+    mask: jax.Array   # [batch, max_len] bool — True where the key is real
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int,
@@ -88,7 +94,8 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int,
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch, max_len, cfg.num_attention_heads, cfg.head_dim)
     return DecodeCache(key=jnp.zeros(shape, dtype), value=jnp.zeros(shape, dtype),
-                       index=jnp.zeros((), jnp.int32))
+                       index=jnp.zeros((), jnp.int32),
+                       mask=jnp.zeros((batch, max_len), bool))
 
 
 class MultiHeadAttention(nn.Module):
@@ -101,7 +108,9 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, *, layer_cache: Optional[dict] = None,
-                 deterministic: bool = True) -> tuple[jax.Array, Optional[dict]]:
+                 deterministic: bool = True,
+                 attention_mask: Optional[jax.Array] = None,
+                 ) -> tuple[jax.Array, Optional[dict]]:
         cfg = self.cfg
         h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
 
@@ -127,13 +136,21 @@ class MultiHeadAttention(nn.Module):
 
         new_cache = None
         if layer_cache is not None:
-            # decode: append this step's k/v at position cache['index']
+            # decode: append this step's k/v at position cache['index'];
+            # the key-validity mask keeps left-pad positions masked forever
             idx = layer_cache["index"]
+            step_mask = (attention_mask.astype(bool) if attention_mask is not None
+                         else jnp.ones(x.shape[:2], bool))
             ck = jax.lax.dynamic_update_slice_in_dim(layer_cache["key"], k, idx, axis=1)
             cv = jax.lax.dynamic_update_slice_in_dim(layer_cache["value"], v, idx, axis=1)
-            new_cache = {"key": ck, "value": cv, "index": idx + x.shape[1]}
+            cm = jax.lax.dynamic_update_slice_in_dim(layer_cache["mask"], step_mask,
+                                                     idx, axis=1)
+            new_cache = {"key": ck, "value": cv, "index": idx + x.shape[1],
+                         "mask": cm}
             k, v = ck, cv
-            attn_out = self._decode_attention(q, k, v, idx)
+            attn_out = self._decode_attention(q, k, v, idx, cm)
+        elif attention_mask is not None:
+            attn_out = self._masked_attn(q, k, v, attention_mask, deterministic)
         else:
             attn_out = self._core_attn(q, k, v, deterministic)
 
@@ -175,15 +192,32 @@ class MultiHeadAttention(nn.Module):
             fn = jax.checkpoint(fn)
         return fn(q, k, v)
 
+    def _masked_attn(self, q, k, v, attention_mask, deterministic) -> jax.Array:
+        """Causal attention with an explicit key-padding mask (left-padded
+        prompts; reference mask handling ``language_module.py:221-243``)."""
+        cfg = self.cfg
+        scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / jnp.sqrt(cfg.head_dim).astype(q.dtype)
+        s = q.shape[1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        mask = causal[None] & attention_mask.astype(bool)[:, None, :]
+        scores = jnp.where(mask[:, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
+            probs = nn.Dropout(cfg.attention_probs_dropout_prob)(
+                probs, deterministic=False)
+        return jnp.einsum("bnqk,bknd->bqnd", probs, v)
+
     @staticmethod
-    def _decode_attention(q, k, v, cache_index) -> jax.Array:
+    def _decode_attention(q, k, v, cache_index, key_mask=None) -> jax.Array:
         """Single/few-token decode against the full cache with length masking."""
         scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
         q_len, k_len = q.shape[1], k.shape[1]
         q_pos = cache_index + jnp.arange(q_len)[:, None]
         k_pos = jnp.arange(k_len)[None, :]
-        mask = k_pos <= q_pos  # causal + only-written-positions
-        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+        mask = (k_pos <= q_pos)[None]  # causal + only-written-positions
+        if key_mask is not None:
+            mask = mask & key_mask.astype(bool)[:, None, :]
+        scores = jnp.where(mask[:, None], scores, jnp.finfo(scores.dtype).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
@@ -235,7 +269,9 @@ class TransformerDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, layer_cache: Optional[dict] = None,
-                 deterministic: bool = True) -> tuple[jax.Array, Optional[dict]]:
+                 deterministic: bool = True,
+                 attention_mask: Optional[jax.Array] = None,
+                 ) -> tuple[jax.Array, Optional[dict]]:
         cfg = self.cfg
         residual = x
         y = LayerNorm(cfg, name="ln1")(x)
@@ -244,12 +280,15 @@ class TransformerDecoderLayer(nn.Module):
         if cfg.use_recompute and cfg.recompute_granularity == "full_attn" and layer_cache is None:
             # remat the whole attention call (reference hybrid_model.py:537-539)
             def attn_fn(mod, y):
-                out, _ = mod(y, layer_cache=None, deterministic=deterministic)
+                out, _ = mod(y, layer_cache=None, deterministic=deterministic,
+                             attention_mask=attention_mask)
                 return out
             y = nn.remat(attn_fn)(attn, y)
             new_cache = None
         else:
-            y, new_cache = attn(y, layer_cache=layer_cache, deterministic=deterministic)
+            y, new_cache = attn(y, layer_cache=layer_cache,
+                                deterministic=deterministic,
+                                attention_mask=attention_mask)
 
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=False)
@@ -295,12 +334,19 @@ class GPTModel(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array, position_ids: jax.Array | None = None,
                  cache: Optional[DecodeCache] = None,
-                 deterministic: bool = True) -> tuple[jax.Array, Optional[DecodeCache]]:
+                 deterministic: bool = True,
+                 attention_mask: Optional[jax.Array] = None,
+                 ) -> tuple[jax.Array, Optional[DecodeCache]]:
         cfg = self.cfg
         if position_ids is None:
-            start = cache.index if cache is not None else 0
-            position_ids = start + jnp.arange(tokens.shape[1])[None, :]
-            position_ids = jnp.broadcast_to(position_ids, tokens.shape)
+            if attention_mask is not None and cache is not None:
+                # left-padded prefill: positions count only real tokens
+                position_ids = jnp.maximum(
+                    jnp.cumsum(attention_mask.astype(jnp.int32), axis=1) - 1, 0)
+            else:
+                start = cache.index if cache is not None else 0
+                position_ids = start + jnp.arange(tokens.shape[1])[None, :]
+                position_ids = jnp.broadcast_to(position_ids, tokens.shape)
 
         x = GPTEmbeddings(cfg, name="embeddings")(tokens, position_ids, deterministic)
 
@@ -312,38 +358,47 @@ class GPTModel(nn.Module):
         if cfg.scan_layers:
             layer_caches = None
             if cache is not None:
-                layer_caches = {"key": cache.key, "value": cache.value,
-                                "index": jnp.broadcast_to(cache.index, (cfg.num_layers,))}
+                layer_caches = {
+                    "key": cache.key, "value": cache.value,
+                    "index": jnp.broadcast_to(cache.index, (cfg.num_layers,)),
+                    "mask": jnp.broadcast_to(cache.mask,
+                                             (cfg.num_layers,) + cache.mask.shape)}
 
             stack = nn.scan(
                 layer,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(0, nn.broadcast),
+                in_axes=(0, nn.broadcast, nn.broadcast),
                 out_axes=0,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
-            x, new_caches = stack(x, layer_caches, deterministic)
+            x, new_caches = stack(x, layer_caches, deterministic, attention_mask)
             new_cache = None
             if cache is not None:
                 new_cache = DecodeCache(key=new_caches["key"], value=new_caches["value"],
-                                        index=new_caches["index"][0])
+                                        index=new_caches["index"][0],
+                                        mask=new_caches["mask"][0])
         else:
             new_k, new_v = [], []
+            new_mask = cache.mask if cache is not None else None
             for i in range(cfg.num_layers):
                 lc = None
                 if cache is not None:
-                    lc = {"key": cache.key[i], "value": cache.value[i], "index": cache.index}
+                    lc = {"key": cache.key[i], "value": cache.value[i],
+                          "index": cache.index, "mask": cache.mask}
                 x, nc = layer(cfg, name=f"layer_{i}")(x, layer_cache=lc,
-                                                      deterministic=deterministic)
+                                                      deterministic=deterministic,
+                                                      attention_mask=attention_mask)
                 if nc is not None:
                     new_k.append(nc["key"])
                     new_v.append(nc["value"])
+                    new_mask = nc["mask"]
             new_cache = None
             if cache is not None:
                 new_cache = DecodeCache(key=jnp.stack(new_k), value=jnp.stack(new_v),
-                                        index=cache.index + tokens.shape[1])
+                                        index=cache.index + tokens.shape[1],
+                                        mask=new_mask)
 
         x = LayerNorm(cfg, name="ln_f")(x)
         return x, new_cache
@@ -357,9 +412,10 @@ class GPTForPretraining(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array, position_ids: jax.Array | None = None,
-                 cache: Optional[DecodeCache] = None, deterministic: bool = True):
+                 cache: Optional[DecodeCache] = None, deterministic: bool = True,
+                 attention_mask: jax.Array | None = None):
         x, new_cache = GPTModel(self.cfg, name="gpt")(
-            tokens, position_ids, cache, deterministic)
+            tokens, position_ids, cache, deterministic, attention_mask)
         wte = self.variables["params"]["gpt"]["embeddings"]["word_embeddings"]
         wte = getattr(wte, "unbox", lambda: wte)()
         # SP gather point (reference hybrid_model.py:738-740) is implicit in the
@@ -371,15 +427,21 @@ class GPTForPretraining(nn.Module):
         return logits
 
 
+def cross_entropy_per_token(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Unreduced token-level LM loss (shared by training loss and the
+    offline PPL eval, reference ``language_module.py:325-389``)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - label_logits
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        loss_mask: jax.Array) -> jax.Array:
     """Masked LM loss (reference ``GPTPretrainingCriterion``,
     ``single_model.py:619-655``; ``ParallelCrossEntropy`` ``hybrid_model.py:820-827``
     — vocab-sharded logits are handled by GSPMD here)."""
-    logits = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    losses = logz - label_logits
+    losses = cross_entropy_per_token(logits, labels)
     loss_mask = loss_mask.astype(jnp.float32).reshape(losses.shape)
     return (losses * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)
 

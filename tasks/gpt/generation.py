@@ -1,0 +1,49 @@
+"""Generation task driver (reference ``tasks/gpt/generation.py:34-62``):
+load checkpoint -> ``module.generate(text)`` -> print continuations."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+from fleetx_tpu.core.checkpoint import latest_step, load_params
+from fleetx_tpu.core.module import GPTGenerationModule
+from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+from fleetx_tpu.utils import config as config_mod
+from fleetx_tpu.utils.log import logger
+
+
+def main():
+    parser_args = config_mod.parse_args("fleetx_tpu generate")
+    cfg = config_mod.get_config(parser_args.config, parser_args.override)
+    module = GPTGenerationModule(cfg)
+
+    gen_cfg = dict(cfg.get("Generation") or {})
+    tok_dir = gen_cfg.get("tokenizer_dir")
+    if tok_dir:
+        module.tokenizer = GPTTokenizer.from_pretrained(tok_dir)
+
+    rng = jax.random.PRNGKey(int(cfg.get("Global", {}).get("seed", 0)))
+    ckpt_dir = cfg.get("Engine", {}).get("save_load", {}).get("ckpt_dir")
+    if ckpt_dir and latest_step(ckpt_dir) is not None:
+        params = load_params(ckpt_dir)
+    else:
+        logger.warning("no checkpoint (ckpt_dir=%r): generating from RANDOM "
+                       "weights — output will be noise", ckpt_dir)
+        params = module.init_variables(rng, {
+            "tokens": jax.numpy.zeros((1, 8), jax.numpy.int32),
+            "position_ids": jax.numpy.zeros((1, 8), jax.numpy.int32)})
+
+    text = gen_cfg.get("input_text", "The quick brown fox")
+    if module.tokenizer is not None:
+        print(module.generate(params, [text], rng)[0])
+    else:
+        prompts = [[int(t) for t in str(text).split()]] \
+            if str(text).replace(" ", "").isdigit() else [[1, 2, 3]]
+        print(module.generate_ids(params, prompts, rng))
+
+
+if __name__ == "__main__":
+    main()
