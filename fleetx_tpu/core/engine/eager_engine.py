@@ -97,7 +97,7 @@ class EagerEngine:
         mp_cfg = dict(eng.get("mix_precision") or {})
         self.use_fp16_scaler = bool(mp_cfg.get("use_pure_fp16")) and (
             getattr(getattr(module, "model_cfg", None), "dtype", None) == jnp.float16)
-        self.init_loss_scale = float(mp_cfg.get("scale_loss", 32768.0))
+        self.init_loss_scale = float(mp_cfg.get("scale_loss") or 32768.0)
 
         dist = dict(self.cfg.get("Distributed") or {})
         self.mesh = mesh if mesh is not None else build_mesh(dist)

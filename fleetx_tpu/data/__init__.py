@@ -10,11 +10,16 @@ from __future__ import annotations
 from fleetx_tpu.data.dataloader import DataLoader, default_collate
 from fleetx_tpu.data.dataset.gpt_dataset import (
     GPTDataset, SyntheticGPTDataset, write_corpus)
+from fleetx_tpu.data.dataset.vision_dataset import (
+    CIFAR10, GeneralClsDataset, SyntheticVisionDataset)
 from fleetx_tpu.data.sampler.batch_sampler import (
     DistributedBatchSampler, GPTBatchSampler)
 
 DATASETS = {"GPTDataset": GPTDataset,
-            "SyntheticGPTDataset": SyntheticGPTDataset}
+            "SyntheticGPTDataset": SyntheticGPTDataset,
+            "GeneralClsDataset": GeneralClsDataset,
+            "CIFAR10": CIFAR10,
+            "SyntheticVisionDataset": SyntheticVisionDataset}
 SAMPLERS = {"GPTBatchSampler": GPTBatchSampler,
             "DistributedBatchSampler": DistributedBatchSampler}
 
@@ -35,7 +40,11 @@ def build_dataset(cfg: dict, mode: str = "Train", **overrides):
     input_dir = section.pop("input_dir", None)
     if input_dir is not None and "data_prefix" not in section:
         section["data_prefix"] = input_dir
-    section.setdefault("seq_length", section.pop("max_seq_len", 1024))
+    if name in ("GPTDataset", "SyntheticGPTDataset"):
+        section.setdefault("seq_length", section.pop("max_seq_len", 1024))
+    else:  # vision datasets have no sequence axis
+        section.pop("seq_length", None)
+        section.pop("max_seq_len", None)
     return cls(**section)
 
 

@@ -1,0 +1,93 @@
+"""Vision datasets: list-file image folders, CIFAR, synthetic.
+
+Reference: ``ppfleetx/data/dataset/vision_dataset.py`` (GeneralClsDataset
+l.26, ImageFolder l.105, CIFAR l.295). All return ``{"images": HWC float,
+"labels": int}`` samples for ``GeneralClsModule``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from fleetx_tpu.data.transforms.preprocess import build_transforms
+
+
+class GeneralClsDataset:
+    """ImageNet-style ``<root>/<list_file>`` with ``path label`` lines
+    (reference ``GeneralClsDataset``)."""
+
+    def __init__(self, image_root: str, cls_label_path: str, transform_ops=None,
+                 delimiter: str = " "):
+        self.root = image_root
+        self.transform = build_transforms(
+            transform_ops or [{"DecodeImage": {}},
+                              {"ResizeImage": {"size": 224}},
+                              {"NormalizeImage": {}}])
+        self.samples: list[tuple[str, int]] = []
+        with open(cls_label_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                path, label = line.rsplit(delimiter, 1)
+                self.samples.append((path, int(label)))
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, i: int) -> dict:
+        path, label = self.samples[i]
+        img = self.transform(os.path.join(self.root, path))
+        return {"images": np.asarray(img, np.float32), "labels": np.int32(label)}
+
+
+class CIFAR10:
+    """CIFAR-10 from the standard local python-pickle batches
+    (reference ``CIFAR``; no download — zero-egress environment)."""
+
+    def __init__(self, data_dir: str, mode: str = "train", transform_ops=None):
+        files = ([f"data_batch_{i}" for i in range(1, 6)] if mode == "train"
+                 else ["test_batch"])
+        xs, ys = [], []
+        for name in files:
+            with open(os.path.join(data_dir, name), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        self.labels = np.asarray(ys, np.int32)
+        self.transform = build_transforms(transform_ops) if transform_ops else None
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def __getitem__(self, i: int) -> dict:
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+        return {"images": np.asarray(img, np.float32), "labels": self.labels[i]}
+
+
+class SyntheticVisionDataset:
+    """Random-image dataset for smoke runs and throughput benchmarking."""
+
+    def __init__(self, *, num_samples: int, image_size: int = 224,
+                 num_classes: int = 1000, seed: int = 0, **_unused):
+        self.num_samples = int(num_samples)
+        self.image_size = int(image_size)
+        self.num_classes = int(num_classes)
+        self.seed = int(seed)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, i: int) -> dict:
+        rng = np.random.RandomState(self.seed + int(i))
+        img = rng.randn(self.image_size, self.image_size, 3).astype(np.float32)
+        return {"images": img,
+                "labels": np.int32(rng.randint(0, self.num_classes))}

@@ -1,0 +1,180 @@
+"""Image preprocessing ops + config-declared chains.
+
+Reference: ``ppfleetx/data/transforms/preprocess.py`` (DecodeImage l.37,
+ResizeImage l.108, RandCropImage l.163, RandFlipImage, NormalizeImage l.232,
+RandomErasing l.330) and the op-chain builder ``transforms/utils.py:18-41``.
+Implemented on PIL + numpy; every op is a callable ``sample -> sample`` over
+HWC uint8/float arrays.
+"""
+
+from __future__ import annotations
+
+import io
+import random
+from typing import Any, Sequence
+
+import numpy as np
+
+try:
+    from PIL import Image
+except ImportError:  # pragma: no cover
+    Image = None
+
+
+class DecodeImage:
+    """bytes/path → HWC uint8 RGB (reference ``DecodeImage``)."""
+
+    def __init__(self, to_rgb: bool = True, channel_first: bool = False):
+        self.to_rgb = to_rgb
+        self.channel_first = channel_first
+
+    def __call__(self, img):
+        if isinstance(img, (bytes, bytearray)):
+            img = Image.open(io.BytesIO(img))
+        elif isinstance(img, str):
+            img = Image.open(img)
+        if Image is not None and isinstance(img, Image.Image):
+            if self.to_rgb:
+                img = img.convert("RGB")
+            img = np.asarray(img)
+        if self.channel_first:
+            img = img.transpose(2, 0, 1)
+        return img
+
+
+class ResizeImage:
+    """Resize shorter side (or fixed size) (reference ``ResizeImage``)."""
+
+    def __init__(self, size=None, resize_short=None, interpolation="bilinear"):
+        assert size is not None or resize_short is not None
+        self.size = size
+        self.resize_short = resize_short
+        self.interpolation = getattr(
+            Image, interpolation.upper(), Image.BILINEAR) if Image else None
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        h, w = img.shape[:2]
+        if self.resize_short:
+            scale = self.resize_short / min(h, w)
+            out = (round(w * scale), round(h * scale))
+        else:
+            s = self.size
+            out = (s, s) if isinstance(s, int) else (s[1], s[0])
+        return np.asarray(Image.fromarray(img).resize(out, self.interpolation))
+
+
+class CenterCropImage:
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        h, w = img.shape[:2]
+        s = self.size
+        top, left = max((h - s) // 2, 0), max((w - s) // 2, 0)
+        return img[top:top + s, left:left + s]
+
+
+class RandCropImage:
+    """Random resized crop (reference ``RandCropImage``)."""
+
+    def __init__(self, size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = random.uniform(*self.ratio)
+            cw = int(round((target * aspect) ** 0.5))
+            ch = int(round((target / aspect) ** 0.5))
+            if cw <= w and ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                crop = img[top:top + ch, left:left + cw]
+                return np.asarray(Image.fromarray(crop).resize(
+                    (self.size, self.size), Image.BILINEAR))
+        return np.asarray(Image.fromarray(img).resize(
+            (self.size, self.size), Image.BILINEAR))
+
+
+class RandFlipImage:
+    def __init__(self, flip_code: int = 1, prob: float = 0.5):
+        self.prob = prob
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        if random.random() < self.prob:
+            return img[:, ::-1]
+        return img
+
+
+class NormalizeImage:
+    """scale + mean/std normalize, optional CHW output (reference l.232)."""
+
+    def __init__(self, scale=1.0 / 255.0, mean=(0.485, 0.456, 0.406),
+                 std=(0.229, 0.224, 0.225), order="hwc", output_fp16: bool = False):
+        self.scale = float(eval(scale)) if isinstance(scale, str) else float(scale)
+        self.mean = np.asarray(mean, np.float32).reshape(1, 1, 3)
+        self.std = np.asarray(std, np.float32).reshape(1, 1, 3)
+        self.order = order
+        self.dtype = np.float16 if output_fp16 else np.float32
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        x = (img.astype(np.float32) * self.scale - self.mean) / self.std
+        if self.order == "chw":
+            x = x.transpose(2, 0, 1)
+        return x.astype(self.dtype)
+
+
+class RandomErasing:
+    """Random-erase augmentation (reference l.330)."""
+
+    def __init__(self, prob: float = 0.25, scale=(0.02, 0.33),
+                 ratio=(0.3, 3.3), value: float = 0.0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        if random.random() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = random.uniform(*self.ratio)
+            eh = int(round((target / aspect) ** 0.5))
+            ew = int(round((target * aspect) ** 0.5))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                img = img.copy()
+                img[top:top + eh, left:left + ew] = self.value
+                return img
+        return img
+
+
+OPS = {cls.__name__: cls for cls in
+       (DecodeImage, ResizeImage, CenterCropImage, RandCropImage,
+        RandFlipImage, NormalizeImage, RandomErasing)}
+
+
+def build_transforms(ops_cfg: Sequence[dict]):
+    """[{OpName: {kwargs}}] → composed callable (reference ``transforms/utils.py``)."""
+    ops = []
+    for item in ops_cfg or []:
+        if isinstance(item, str):
+            name, kwargs = item, {}
+        else:
+            (name, kwargs), = item.items()
+        ops.append(OPS[name](**(kwargs or {})))
+
+    def apply(x: Any) -> Any:
+        for op in ops:
+            x = op(x)
+        return x
+
+    return apply

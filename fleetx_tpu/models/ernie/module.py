@@ -1,0 +1,55 @@
+"""ERNIE pretraining task module (reference ``ernie_module.py:56-102``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.core.module import BasicModule
+from fleetx_tpu.models.ernie.model import (ErnieForPretraining,
+                                           config_from_dict,
+                                           pretraining_criterion)
+from fleetx_tpu.utils.log import logger
+
+
+class ErnieModule(BasicModule):
+    def __init__(self, cfg: Any):
+        model_cfg = cfg.get("Model", cfg) if isinstance(cfg, dict) else cfg
+        self.model_cfg = config_from_dict(dict(model_cfg))
+        self.binary_head = bool(model_cfg.get("binary_head", True))
+        super().__init__(cfg)
+        logger.info("ERNIE model: layers=%d hidden=%d heads=%d vocab=%d",
+                    self.model_cfg.num_layers, self.model_cfg.hidden_size,
+                    self.model_cfg.num_attention_heads, self.model_cfg.vocab_size)
+
+    def get_model(self):
+        return ErnieForPretraining(self.model_cfg)
+
+    def init_variables(self, rng: jax.Array, batch: dict) -> Any:
+        return self.model.init(
+            {"params": rng}, batch["input_ids"][:1],
+            batch.get("token_type_ids", batch["input_ids"])[:1],
+            deterministic=True)["params"]
+
+    def _forward_loss(self, params, batch, rngs=None, deterministic=True):
+        from flax.core import meta
+
+        mlm_logits, nsp_logits = self.model.apply(
+            {"params": meta.unbox(params)}, batch["input_ids"],
+            batch.get("token_type_ids"), batch.get("position_ids"),
+            batch.get("attention_mask"), deterministic=deterministic,
+            rngs=rngs or {})
+        nsp_labels = batch.get("next_sentence_labels") if self.binary_head else None
+        loss, mlm, nsp = pretraining_criterion(
+            mlm_logits, nsp_logits, batch["mlm_labels"], nsp_labels)
+        return loss, {"loss": loss, "mlm_loss": mlm, "nsp_loss": nsp}
+
+    def training_loss(self, params, batch, rng, step):
+        dropout_rng = jax.random.fold_in(rng, step)
+        return self._forward_loss(params, batch, rngs={"dropout": dropout_rng},
+                                  deterministic=False)
+
+    def validation_loss(self, params, batch):
+        return self._forward_loss(params, batch, deterministic=True)

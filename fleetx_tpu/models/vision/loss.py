@@ -1,0 +1,47 @@
+"""Classification losses + metrics.
+
+Reference: ``ppfleetx/models/vision_model/loss/cross_entropy.py:25,64``
+(CELoss / ViTCELoss with label smoothing) and
+``metrics/accuracy.py:19`` (TopkAcc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  label_smoothing: float = 0.0) -> jax.Array:
+    """Mean CE over the batch; ``labels`` int [b] or one-hot/soft [b, C]."""
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    if labels.ndim == logits.ndim - 1:
+        targets = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    else:
+        targets = labels.astype(jnp.float32)
+    if label_smoothing > 0.0:
+        targets = (1.0 - label_smoothing) * targets + label_smoothing / num_classes
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -(targets * logp).sum(axis=-1).mean()
+
+
+def vit_cross_entropy(logits: jax.Array, labels: jax.Array,
+                      label_smoothing: float = 0.0001) -> jax.Array:
+    """ViT variant defaults to a tiny smoothing (reference ``ViTCELoss``)."""
+    return cross_entropy(logits, labels, label_smoothing)
+
+
+def topk_accuracy(logits: jax.Array, labels: jax.Array,
+                  topk=(1, 5)) -> dict[str, jax.Array]:
+    """Top-k accuracies (reference ``TopkAcc``)."""
+    if labels.ndim > 1:
+        labels = jnp.argmax(labels, axis=-1)
+    out = {}
+    max_k = min(max(topk), logits.shape[-1])
+    _, pred = jax.lax.top_k(logits, max_k)
+    hit = pred == labels[:, None]
+    for k in topk:
+        k_eff = min(k, logits.shape[-1])
+        out[f"top{k}"] = hit[:, :k_eff].any(axis=1).astype(jnp.float32).mean()
+    return out

@@ -1,0 +1,82 @@
+"""Image-classification task module.
+
+Reference: ``ppfleetx/models/vision_model/general_classification_module.py:38-161``
+— name-driven model/loss/metric build, per-step images/sec metrics, eval
+top-1/top-5 aggregation (all_gather'd in the reference; here GSPMD's global
+reductions make the jitted metric already global).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.core.module import BasicModule
+from fleetx_tpu.models.vision import loss as L
+from fleetx_tpu.models.vision.vit import ViT, config_from_dict, PRESETS
+from fleetx_tpu.utils.log import logger
+
+
+class GeneralClsModule(BasicModule):
+    """Generic classification module (reference ``general_classification_module.py``)."""
+
+    def __init__(self, cfg: Any):
+        model_cfg = dict(cfg.get("Model", cfg) if isinstance(cfg, dict) else cfg)
+        name = model_cfg.get("name", "ViT_base_patch16_224")
+        preset = dict(PRESETS.get(name) or {})
+        preset.update({k: v for k, v in model_cfg.get("model", {}).items()
+                       if v is not None} if isinstance(model_cfg.get("model"), dict)
+                      else {})
+        for key in ("num_classes", "image_size", "drop_path_rate", "dtype",
+                    "param_dtype", "use_recompute", "scan_layers"):
+            if model_cfg.get(key) is not None:
+                preset[key] = model_cfg[key]
+        self.vit_cfg = config_from_dict(preset)
+        loss_cfg = dict(model_cfg.get("loss") or {})
+        self.label_smoothing = float(loss_cfg.get("epsilon",
+                                                  loss_cfg.get("label_smoothing", 0.0)))
+        topk = (model_cfg.get("metric") or {}).get("topk", (1, 5))
+        self.topk = tuple(int(k) for k in topk)
+        super().__init__(cfg)
+        logger.info("ViT model: layers=%d hidden=%d heads=%d classes=%d",
+                    self.vit_cfg.num_layers, self.vit_cfg.hidden_size,
+                    self.vit_cfg.num_attention_heads, self.vit_cfg.num_classes)
+
+    def get_model(self):
+        return ViT(self.vit_cfg)
+
+    def init_variables(self, rng: jax.Array, batch: dict) -> Any:
+        return self.model.init({"params": rng}, batch["images"][:1],
+                               deterministic=True)["params"]
+
+    def training_loss(self, params, batch, rng, step):
+        from flax.core import meta
+
+        dropout_rng = jax.random.fold_in(rng, step)
+        logits = self.model.apply({"params": meta.unbox(params)},
+                                  batch["images"], deterministic=False,
+                                  rngs={"dropout": dropout_rng})
+        loss = L.vit_cross_entropy(logits, batch["labels"], self.label_smoothing)
+        return loss, {"loss": loss}
+
+    def validation_loss(self, params, batch):
+        from flax.core import meta
+
+        logits = self.model.apply({"params": meta.unbox(params)},
+                                  batch["images"], deterministic=True)
+        loss = L.cross_entropy(logits, batch["labels"])
+        metrics = {"loss": loss}
+        metrics.update(L.topk_accuracy(logits, batch["labels"], self.topk))
+        return loss, metrics
+
+    def training_step_end(self, log_dict: dict) -> None:
+        speed = 1.0 / max(log_dict.get("train_cost", 1e-9), 1e-9)
+        ips = log_dict.get("global_batch_size", 1) * speed
+        logger.info(
+            "[train] global step %d, batch: %d, loss: %.9f, "
+            "avg_batch_cost: %.5f sec, speed: %.2f step/s, ips: %.1f images/s, "
+            "learning rate: %.5e",
+            log_dict["global_step"], log_dict["batch"], log_dict["loss"],
+            log_dict.get("train_cost", 0.0), speed, ips, log_dict.get("lr", 0.0))
