@@ -9,7 +9,9 @@ from __future__ import annotations
 
 from fleetx_tpu.data.dataloader import DataLoader, default_collate
 from fleetx_tpu.data.dataset.gpt_dataset import (
-    GPTDataset, SyntheticGPTDataset, write_corpus)
+    BlendedDataset, GPTDataset, SyntheticGPTDataset, write_corpus)
+from fleetx_tpu.data.dataset.multimodal_dataset import (
+    ImagenDataset, SyntheticImagenDataset)
 from fleetx_tpu.data.dataset.vision_dataset import (
     CIFAR10, GeneralClsDataset, SyntheticVisionDataset)
 from fleetx_tpu.data.sampler.batch_sampler import (
@@ -17,9 +19,12 @@ from fleetx_tpu.data.sampler.batch_sampler import (
 
 DATASETS = {"GPTDataset": GPTDataset,
             "SyntheticGPTDataset": SyntheticGPTDataset,
+            "BlendedDataset": BlendedDataset,
             "GeneralClsDataset": GeneralClsDataset,
             "CIFAR10": CIFAR10,
-            "SyntheticVisionDataset": SyntheticVisionDataset}
+            "SyntheticVisionDataset": SyntheticVisionDataset,
+            "ImagenDataset": ImagenDataset,
+            "SyntheticImagenDataset": SyntheticImagenDataset}
 SAMPLERS = {"GPTBatchSampler": GPTBatchSampler,
             "DistributedBatchSampler": DistributedBatchSampler}
 
@@ -36,23 +41,39 @@ def build_dataset(cfg: dict, mode: str = "Train", **overrides):
     if cls is None:
         raise ValueError(f"unknown dataset {name!r}")
     section.pop("split", None)  # handled by callers building per-split sets
+    if name == "BlendedDataset":
+        # weighted mixture: build each child dataset recursively, passing
+        # the same shape overrides (seq_length, vocab_size, ...)
+        children = [build_dataset({"dataset": child}, mode="_child_",
+                                  **overrides)
+                    for child in (section.get("datasets") or [])]
+        return BlendedDataset(children, section.get("weights"),
+                              int(section.get("num_samples")))
     section.update(overrides)
     input_dir = section.pop("input_dir", None)
     if input_dir is not None and "data_prefix" not in section:
         section["data_prefix"] = input_dir
     if name in ("GPTDataset", "SyntheticGPTDataset"):
         section.setdefault("seq_length", section.pop("max_seq_len", 1024))
-    else:  # vision datasets have no sequence axis
+    else:  # vision/multimodal datasets have no sequence axis
         section.pop("seq_length", None)
         section.pop("max_seq_len", None)
+    if name != "SyntheticGPTDataset":
+        # vocab_size is plumbed from Model config for the synthetic stream
+        # (token range must match the embedding table); real datasets carry
+        # their own vocabulary
+        section.pop("vocab_size", None)
     return cls(**section)
 
 
 def build_dataloader(cfg: dict, mode: str = "Train", *,
                      num_replicas: int = 1, rank: int = 0,
-                     consumed_samples: int = 0, **dataset_overrides):
+                     consumed_samples: int = 0, batch_size: int | None = None,
+                     **dataset_overrides):
     """Dataset + sampler + loader from a config ``Data.{mode}`` section
-    (reference ``build_dataloader``, ``data/__init__.py:42-73``)."""
+    (reference ``build_dataloader``, ``data/__init__.py:42-73``).
+    ``batch_size`` overrides the config value (per-host batch derived by the
+    caller from global_batch_size / process count)."""
     section = dict(cfg.get(mode) or cfg)
     dataset = build_dataset(cfg, mode, **dataset_overrides)
     sampler_cfg = dict(section.get("sampler") or {})
@@ -60,8 +81,10 @@ def build_dataloader(cfg: dict, mode: str = "Train", *,
                            "GPTBatchSampler" if mode == "Train"
                            else "DistributedBatchSampler")
     loader_cfg = dict(section.get("loader") or {})
-    batch_size = int(loader_cfg.get("batch_size",
-                                    sampler_cfg.pop("batch_size", 1)))
+    if batch_size is None:
+        batch_size = int(loader_cfg.get("batch_size",
+                                        sampler_cfg.pop("batch_size", 1)))
+    sampler_cfg.pop("batch_size", None)
     kwargs = dict(num_replicas=num_replicas, rank=rank,
                   drop_last=bool(sampler_cfg.pop("drop_last", True)))
     if name == "GPTBatchSampler":

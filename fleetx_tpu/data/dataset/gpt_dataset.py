@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 
 import numpy as np
 
@@ -115,6 +116,25 @@ def build_index_mappings(name: str, cache_dir: str, sizes: np.ndarray,
         return tuple(np.load(paths[k], mmap_mode="r")
                      for k in ("doc", "sample", "shuffle"))
 
+    # multi-host: only process 0 builds; others poll for the published files
+    # (reference rank-0-builds + dist.barrier, gpt_dataset.py:284-373 — the
+    # barrier becomes a filesystem wait on atomically-renamed outputs)
+    try:
+        import jax
+        n_proc, proc_id = jax.process_count(), jax.process_index()
+    except Exception:
+        n_proc, proc_id = 1, 0
+    if n_proc > 1 and proc_id != 0:
+        deadline = time.time() + 600.0
+        while not all(os.path.exists(p) for p in paths.values()):
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"index mappings for {name} not published by process 0 "
+                    f"within 600s under {cache_dir}")
+            time.sleep(1.0)
+        return tuple(np.load(paths[k], mmap_mode="r")
+                     for k in ("doc", "sample", "shuffle"))
+
     rng = np.random.RandomState(seed)
     tokens_per_epoch = int(sizes[documents].sum())
     num_epochs = _num_epochs(tokens_per_epoch, seq_length, num_samples)
@@ -134,7 +154,9 @@ def build_index_mappings(name: str, cache_dir: str, sizes: np.ndarray,
         from fleetx_tpu.data.native import index_builder
         sample_idx = index_builder.build_sample_idx(
             sizes.astype(np.int32), doc_idx, seq_length, num_samples)
-    except Exception:
+    except Exception as e:  # toolchain missing — numpy path is byte-identical
+        logger.warning("native index builder unavailable (%s: %s); "
+                       "using numpy fallback", type(e).__name__, e)
         sample_idx = build_sample_idx(sizes, doc_idx, seq_length, num_samples)
 
     if separate_last_epoch:
@@ -143,9 +165,13 @@ def build_index_mappings(name: str, cache_dir: str, sizes: np.ndarray,
         num_samples_ = sample_idx.shape[0] - 1
     shuffle_idx = build_shuffle_idx(num_samples_, sample_idx.shape[0] - 1, rng)
 
-    np.save(paths["doc"], doc_idx, allow_pickle=False)
-    np.save(paths["sample"], sample_idx, allow_pickle=False)
-    np.save(paths["shuffle"], shuffle_idx, allow_pickle=False)
+    # atomic publish: write to a tmp name, then rename — concurrent same-host
+    # processes and the multi-host pollers above never see partial files
+    for kind, arr in (("doc", doc_idx), ("sample", sample_idx),
+                      ("shuffle", shuffle_idx)):
+        tmp = paths[kind][:-len(".npy")] + f".tmp{os.getpid()}.npy"
+        np.save(tmp, arr, allow_pickle=False)
+        os.replace(tmp, paths[kind])
     logger.info("built index mappings for %s: %d samples, %d epochs",
                 name, sample_idx.shape[0] - 1, num_epochs)
     return doc_idx, sample_idx, shuffle_idx
@@ -211,6 +237,53 @@ class GPTDataset:
         position_ids = np.arange(self.seq_length, dtype=np.int32)
         return {"tokens": tokens, "position_ids": position_ids,
                 "labels": labels, "loss_mask": loss_mask}
+
+
+def build_blending_indices(weights: np.ndarray,
+                           num_samples: int) -> tuple[np.ndarray, np.ndarray]:
+    """Greedy weighted assignment of samples to datasets (numpy counterpart
+    of the native ``build_blending_indices``; reference
+    ``fast_index_map_helpers.cpp:32-89``)."""
+    weights = np.asarray(weights, np.float64)
+    counts = np.zeros(len(weights), np.int64)
+    ds_idx = np.empty(num_samples, np.int32)
+    ds_sample_idx = np.empty(num_samples, np.int64)
+    for i in range(num_samples):
+        errs = weights * (i + 1) - counts
+        best = int(np.argmax(errs))
+        ds_idx[i] = best
+        ds_sample_idx[i] = counts[best]
+        counts[best] += 1
+    return ds_idx, ds_sample_idx
+
+
+class BlendedDataset:
+    """Weighted mixture of datasets (reference multi-corpus blending via
+    ``build_blending_indices``). ``datasets`` map-style; ``weights`` are
+    normalised; sample ``i`` of the blend comes from
+    ``datasets[dataset_index[i]][dataset_sample_index[i] % len]``."""
+
+    def __init__(self, datasets: list, weights: list[float], num_samples: int):
+        assert len(datasets) == len(weights) and datasets
+        w = np.asarray(weights, np.float64)
+        w = w / w.sum()
+        self.datasets = datasets
+        try:
+            from fleetx_tpu.data.native import index_builder
+            self.dataset_index, self.dataset_sample_index = \
+                index_builder.build_blending_indices(w, num_samples)
+        except Exception as e:
+            logger.warning("native blending builder unavailable (%s); "
+                           "using numpy fallback", e)
+            self.dataset_index, self.dataset_sample_index = \
+                build_blending_indices(w, num_samples)
+
+    def __len__(self) -> int:
+        return len(self.dataset_index)
+
+    def __getitem__(self, i: int) -> dict:
+        ds = self.datasets[int(self.dataset_index[i])]
+        return ds[int(self.dataset_sample_index[i]) % len(ds)]
 
 
 class SyntheticGPTDataset:

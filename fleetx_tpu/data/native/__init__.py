@@ -1,0 +1,90 @@
+"""ctypes binding for the native index builder.
+
+The reference JIT-compiles its pybind11 helper with ``make`` on first use
+(``ppfleetx/data/dataset/gpt_dataset.py:47-69``); this does the same for a
+plain C-ABI shared object (the image has no pybind11 — ctypes avoids any
+build-time Python dependency). ``index_builder`` raises ImportError-style
+failures loudly; callers decide whether to fall back to the numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libindex_builder.so")
+_lock = threading.Lock()
+
+
+class _IndexBuilder:
+    """Lazy build-on-first-use wrapper (reference compile.py semantics)."""
+
+    def __init__(self) -> None:
+        self._lib: ctypes.CDLL | None = None
+
+    def _ensure(self) -> ctypes.CDLL:
+        if self._lib is not None:
+            return self._lib
+        with _lock:
+            if self._lib is not None:
+                return self._lib
+            src = os.path.join(_DIR, "index_builder.cpp")
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(src)):
+                subprocess.check_call(
+                    ["make", "-C", _DIR], stdout=subprocess.DEVNULL)
+            lib = ctypes.CDLL(_SO)
+            lib.build_sample_idx.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.build_sample_idx.restype = None
+            lib.build_blending_indices.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.build_blending_indices.restype = None
+            self._lib = lib
+            return lib
+
+    @staticmethod
+    def _ptr(arr: np.ndarray, ctype):
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    def build_sample_idx(self, sizes: np.ndarray, doc_idx: np.ndarray,
+                         seq_length: int, num_samples: int) -> np.ndarray:
+        """[num_samples+1, 2] (doc_idx position, token offset) — identical to
+        the numpy ``gpt_dataset.build_sample_idx``."""
+        lib = self._ensure()
+        sizes = np.ascontiguousarray(sizes, np.int32)
+        doc_idx = np.ascontiguousarray(doc_idx, np.int32)
+        total = int(sizes[doc_idx].astype(np.int64).sum())
+        num_samples = min(int(num_samples), (total - 1) // int(seq_length))
+        out = np.empty((num_samples + 1, 2), np.int64)
+        lib.build_sample_idx(
+            self._ptr(sizes, ctypes.c_int32), self._ptr(doc_idx, ctypes.c_int32),
+            len(doc_idx), int(seq_length), num_samples,
+            self._ptr(out, ctypes.c_int64))
+        return out
+
+    def build_blending_indices(self, weights: np.ndarray,
+                               num_samples: int) -> tuple[np.ndarray, np.ndarray]:
+        """(dataset_index, dataset_sample_index) for weighted corpus blending."""
+        lib = self._ensure()
+        weights = np.ascontiguousarray(weights, np.float64)
+        assert len(weights) <= 256, "at most 256 blended datasets"
+        ds_idx = np.empty(int(num_samples), np.int32)
+        ds_sample_idx = np.empty(int(num_samples), np.int64)
+        lib.build_blending_indices(
+            self._ptr(weights, ctypes.c_double), len(weights), int(num_samples),
+            self._ptr(ds_idx, ctypes.c_int32),
+            self._ptr(ds_sample_idx, ctypes.c_int64))
+        return ds_idx, ds_sample_idx
+
+
+index_builder = _IndexBuilder()

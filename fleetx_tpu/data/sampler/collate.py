@@ -1,0 +1,102 @@
+"""Composable batch-collate helpers.
+
+Reference: ``ppfleetx/data/sampler/collate.py`` — ``Stack`` (l.27), ``Pad``
+(l.70), ``Tuple`` (l.173), ``Dict`` (l.248). Same composition semantics
+(each helper is a callable over a list of per-sample fields; ``Tuple`` /
+``Dict`` route sample components to per-field collators), re-implemented
+over numpy only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+__all__ = ["Stack", "Pad", "Tuple", "Dict"]
+
+
+class Stack:
+    """Stack equal-shape fields into ``[batch, ...]``; optional dtype cast."""
+
+    def __init__(self, dtype=None, axis: int = 0):
+        self.dtype = dtype
+        self.axis = axis
+
+    def __call__(self, data: Sequence[Any]) -> np.ndarray:
+        out = np.stack([np.asarray(d) for d in data], axis=self.axis)
+        return out.astype(self.dtype) if self.dtype else out
+
+
+class Pad:
+    """Pad ragged 1-d (or leading-dim) fields to the batch max length.
+
+    ``ret_length`` additionally returns the true lengths (reference Pad
+    semantics); ``pad_right=False`` left-pads (GPT prompt convention).
+    """
+
+    def __init__(self, pad_val=0, axis: int = 0, ret_length: bool = False,
+                 dtype=None, pad_right: bool = True):
+        self.pad_val = pad_val
+        self.axis = axis
+        self.ret_length = ret_length
+        self.dtype = dtype
+        self.pad_right = pad_right
+
+    def __call__(self, data: Sequence[Any]):
+        arrays = [np.asarray(d) for d in data]
+        lengths = np.array([a.shape[self.axis] for a in arrays], np.int64)
+        max_len = int(lengths.max()) if len(arrays) else 0
+        out = []
+        for a in arrays:
+            pad_width = [(0, 0)] * a.ndim
+            need = max_len - a.shape[self.axis]
+            pad_width[self.axis] = (0, need) if self.pad_right else (need, 0)
+            out.append(np.pad(a, pad_width, constant_values=self.pad_val))
+        batch = np.stack(out)
+        if self.dtype:
+            batch = batch.astype(self.dtype)
+        if self.ret_length:
+            return batch, lengths
+        return batch
+
+
+class Tuple:
+    """Route tuple/list sample components to per-component collators
+    (reference l.173-246: ``Tuple(Stack(), Pad(0))`` etc.)."""
+
+    def __init__(self, *fn: Callable):
+        if len(fn) == 1 and isinstance(fn[0], (list, tuple)):
+            fn = tuple(fn[0])
+        self.fn = fn
+
+    def __call__(self, data: Sequence[Sequence[Any]]):
+        assert all(len(d) == len(self.fn) for d in data), \
+            f"sample arity != {len(self.fn)} collators"
+        out = []
+        for i, f in enumerate(self.fn):
+            result = f([d[i] for d in data])
+            # flatten (batch, lengths) pairs the way the reference does
+            if isinstance(result, tuple):
+                out.extend(result)
+            else:
+                out.append(result)
+        return tuple(out)
+
+
+class Dict:
+    """Route dict sample fields to per-key collators (reference l.248-317)."""
+
+    def __init__(self, fn: dict[str, Callable]):
+        self.fn = dict(fn)
+
+    def __call__(self, data: Sequence[dict]):
+        out = {}
+        for key, f in self.fn.items():
+            result = f([d[key] for d in data])
+            if isinstance(result, tuple):
+                out[key] = result[0]
+                out[key + "_length"] = result[1]
+            else:
+                out[key] = result
+        return out

@@ -1,0 +1,81 @@
+"""Native C++ index builder vs the numpy path: byte-identical outputs.
+
+The reference requires its C++ helper's outputs verbatim (SURVEY.md §2.5);
+here equality is property-tested over random corpora.
+"""
+
+import numpy as np
+import pytest
+
+from fleetx_tpu.data.dataset import gpt_dataset as G
+
+native = pytest.importorskip("fleetx_tpu.data.native")
+
+
+def _native_ok():
+    try:
+        native.index_builder._ensure()
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _native_ok(),
+                                reason="no C++ toolchain available")
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_build_sample_idx_matches_numpy(seed):
+    rng = np.random.RandomState(seed)
+    n_docs = rng.randint(1, 200)
+    sizes = rng.randint(1, 50, size=n_docs).astype(np.int32)
+    # include zero-length docs occasionally (boundary skipping)
+    if seed % 2:
+        sizes[rng.randint(0, n_docs, size=max(1, n_docs // 10))] = 0
+    epochs = rng.randint(1, 4)
+    doc_idx = np.tile(np.arange(n_docs, dtype=np.int32), epochs)
+    rng.shuffle(doc_idx)
+    seq_length = int(rng.randint(4, 33))
+    total = int(sizes[doc_idx].sum())
+    if total <= seq_length:
+        pytest.skip("degenerate corpus")
+    num_samples = int(rng.randint(1, max(2, (total - 1) // seq_length + 5)))
+
+    ref = G.build_sample_idx(sizes, doc_idx, seq_length, num_samples)
+    got = native.index_builder.build_sample_idx(sizes, doc_idx, seq_length,
+                                                num_samples)
+    assert got.dtype == ref.dtype and got.shape == ref.shape
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_build_blending_indices_matches_numpy(seed):
+    rng = np.random.RandomState(seed)
+    n = rng.randint(2, 8)
+    w = rng.rand(n) + 0.01
+    w = w / w.sum()
+    num_samples = int(rng.randint(10, 2000))
+    ref_idx, ref_sample = G.build_blending_indices(w, num_samples)
+    got_idx, got_sample = native.index_builder.build_blending_indices(
+        w, num_samples)
+    np.testing.assert_array_equal(got_idx, ref_idx)
+    np.testing.assert_array_equal(got_sample, ref_sample)
+    # every dataset's share approaches its weight
+    counts = np.bincount(ref_idx, minlength=n)
+    np.testing.assert_allclose(counts / num_samples, w, atol=n / num_samples)
+
+
+def test_blended_dataset_mixes():
+    class Const:
+        def __init__(self, v):
+            self.v = v
+
+        def __len__(self):
+            return 7
+
+        def __getitem__(self, i):
+            return {"v": self.v, "i": i}
+
+    ds = G.BlendedDataset([Const(0), Const(1)], [0.75, 0.25], 100)
+    vs = [ds[i]["v"] for i in range(100)]
+    assert 65 <= sum(1 for v in vs if v == 0) <= 85

@@ -1,0 +1,68 @@
+"""preprocess_data CLI: raw text → memmap pair → trainable GPTDataset."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from fleetx_tpu.data.dataset.gpt_dataset import GPTDataset
+from fleetx_tpu.data.tokenizers.gpt_tokenizer import train_bpe
+
+
+@pytest.fixture(scope="module")
+def tokenizer_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tok")
+    texts = ["the quick brown fox jumps over the lazy dog",
+             "pack my box with five dozen liquor jugs"] * 20
+    tok = train_bpe(texts, vocab_size=400)
+    tok.save_pretrained(str(d))
+    return str(d)
+
+
+def test_jsonl_roundtrip(tmp_path, tokenizer_dir):
+    import preprocess_data
+
+    corpus = tmp_path / "corpus.jsonl"
+    docs = ["the quick brown fox", "five dozen liquor jugs",
+            "the lazy dog jumps"] * 5
+    with open(corpus, "w") as f:
+        for d in docs:
+            f.write(json.dumps({"text": d}) + "\n")
+
+    prefix = str(tmp_path / "out" / "corpus")
+    rc = preprocess_data.main([
+        "--input", str(corpus), "--tokenizer", tokenizer_dir,
+        "--output-prefix", prefix, "--workers", "2", "--append-eos",
+        "--eos-id", "0", "--log-interval", "0"])
+    assert rc == 0
+
+    ids = np.load(prefix + "_ids.npy")
+    lens = np.load(prefix + "_idx.npz")["lens"]
+    assert len(lens) == len(docs)
+    assert ids.shape[0] == lens.sum()
+    # every doc ends with the requested eos
+    ends = np.cumsum(lens) - 1
+    assert (ids[ends] == 0).all()
+
+    ds = GPTDataset(prefix, num_samples=8, seq_length=8, seed=0, eos_id=0)
+    sample = ds[0]
+    assert sample["tokens"].shape == (8,)
+    assert sample["loss_mask"].shape == (8,)
+
+
+def test_plain_text_blank_line_splits(tmp_path, tokenizer_dir):
+    import preprocess_data
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("doc one line a\ndoc one line b\n\ndoc two\n")
+    prefix = str(tmp_path / "c")
+    rc = preprocess_data.main([
+        "--input", str(corpus), "--tokenizer", tokenizer_dir,
+        "--output-prefix", prefix, "--workers", "1", "--log-interval", "0"])
+    assert rc == 0
+    lens = np.load(prefix + "_idx.npz")["lens"]
+    assert len(lens) == 2

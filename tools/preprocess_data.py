@@ -1,0 +1,134 @@
+"""Offline corpus preprocessing: raw text / jsonl → ``_ids.npy`` + ``_idx.npz``.
+
+Reference: ``ppfleetx/data/data_tools/gpt/preprocess_data.py:241-297``
+(multiprocess ``Converter`` pool tokenizing json lines into the Megatron
+memmap pair) and ``raw_trans_to_json.py`` (plain text → jsonl). Both stages
+collapse into one CLI here:
+
+    python tools/preprocess_data.py \
+        --input corpus.jsonl --json-key text \
+        --tokenizer ./tokenizer_dir --output-prefix ./data/openwebtext \
+        --workers 8 --append-eos
+
+Input formats (auto-detected by extension):
+- ``.jsonl`` / ``.json`` — one JSON object per line, text under ``--json-key``
+- anything else — plain text, one document per line (blank lines split docs)
+
+Output: ``{prefix}_ids.npy`` (flat uint16/uint32 token stream) and
+``{prefix}_idx.npz`` (per-document lengths) — exactly what ``GPTDataset``
+mmaps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_worker_tokenizer = None
+_worker_args = None
+
+
+def _init_worker(tokenizer_path: str, args_dict: dict):
+    global _worker_tokenizer, _worker_args
+    from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+    _worker_tokenizer = GPTTokenizer.from_pretrained(tokenizer_path)
+    _worker_args = args_dict
+
+
+def _encode_doc(text: str) -> list[int]:
+    ids = _worker_tokenizer.encode(text)
+    if _worker_args["append_eos"]:
+        ids.append(_worker_args["eos_id"])
+    return ids
+
+
+def iter_documents(path: str, json_key: str):
+    """Yield document strings from jsonl or plain text."""
+    is_json = path.endswith((".jsonl", ".json"))
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        if is_json:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)[json_key]
+                except (json.JSONDecodeError, KeyError):
+                    continue
+        else:
+            buf: list[str] = []
+            for line in f:
+                if line.strip():
+                    buf.append(line.strip())
+                elif buf:
+                    yield " ".join(buf)
+                    buf = []
+            if buf:
+                yield " ".join(buf)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--input", required=True, help="corpus file (jsonl or txt)")
+    p.add_argument("--json-key", default="text")
+    p.add_argument("--tokenizer", required=True,
+                   help="dir with vocab.json + merges.txt")
+    p.add_argument("--output-prefix", required=True)
+    p.add_argument("--workers", type=int, default=max(os.cpu_count() // 2, 1))
+    p.add_argument("--append-eos", action="store_true")
+    p.add_argument("--eos-id", type=int, default=50256)
+    p.add_argument("--log-interval", type=int, default=10000)
+    args = p.parse_args(argv)
+
+    from fleetx_tpu.utils.log import logger
+
+    t0 = time.time()
+    chunks: list[np.ndarray] = []
+    lens: list[int] = []
+    total_tokens = 0
+    worker_args = {"append_eos": args.append_eos, "eos_id": args.eos_id}
+
+    with multiprocessing.Pool(
+            args.workers, initializer=_init_worker,
+            initargs=(args.tokenizer, worker_args)) as pool:
+        docs = iter_documents(args.input, args.json_key)
+        for i, ids in enumerate(pool.imap(_encode_doc, docs, chunksize=64)):
+            if not ids:
+                continue
+            chunks.append(np.asarray(ids, np.int64))
+            lens.append(len(ids))
+            total_tokens += len(ids)
+            if args.log_interval and (i + 1) % args.log_interval == 0:
+                rate = total_tokens / max(time.time() - t0, 1e-9)
+                logger.info("processed %d docs, %d tokens (%.0f tok/s)",
+                            i + 1, total_tokens, rate)
+
+    if not chunks:
+        logger.error("no documents found in %s", args.input)
+        return 1
+
+    flat = np.concatenate(chunks)
+    dtype = np.uint16 if flat.max() < 2 ** 16 else np.uint32
+    os.makedirs(os.path.dirname(os.path.abspath(args.output_prefix)),
+                exist_ok=True)
+    np.save(args.output_prefix + "_ids.npy", flat.astype(dtype),
+            allow_pickle=False)
+    np.savez(args.output_prefix + "_idx.npz",
+             lens=np.asarray(lens, np.int64))
+    logger.info("wrote %s_ids.npy (%d docs, %d tokens, %s) in %.1fs",
+                args.output_prefix, len(lens), total_tokens, dtype.__name__,
+                time.time() - t0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,16 +56,18 @@ def main():
     n_proc = jax.process_count()
     per_host_bs = int(glb.get("global_batch_size", 8)) // n_proc
     data_cfg = cfg.get("Data") or {}
+    shape_kwargs = dict(
+        seq_length=int(glb.get("max_seq_len", 1024)),
+        vocab_size=int((cfg.get("Model") or {}).get("vocab_size") or 50304))
     train_dl = build_dataloader(
         data_cfg, "Train", num_replicas=n_proc, rank=jax.process_index(),
         consumed_samples=consumed,  # global-sample units, same as the sampler
-        **{"seq_length": int(glb.get("max_seq_len", 1024))})
-    train_dl.batch_sampler.batch_size = per_host_bs
+        batch_size=per_host_bs, **shape_kwargs)
     valid_dl = None
     if (data_cfg.get("Eval") or {}).get("dataset"):
         valid_dl = build_dataloader(
-            data_cfg, "Eval", num_replicas=n_proc, rank=jax.process_index())
-        valid_dl.batch_sampler.batch_size = per_host_bs
+            data_cfg, "Eval", num_replicas=n_proc, rank=jax.process_index(),
+            batch_size=per_host_bs, **shape_kwargs)
 
     engine._consumed_samples = consumed
     engine.fit(train_dl, valid_dl,
