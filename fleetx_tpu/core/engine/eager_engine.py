@@ -103,10 +103,30 @@ class EagerEngine:
         self.mesh = mesh if mesh is not None else build_mesh(dist)
         self.rules = make_axis_rules(dist)
         self.sharding_stage = int((dist.get("sharding") or {}).get("sharding_stage") or 0)
+        self.pp_degree = int(dist.get("pp_degree") or 1)
+        if self.pp_degree > 1:
+            # the pipeline consumes the local batch as micro-batches itself
+            # (reference train_batch semantics, eager_engine.py:400-410) — the
+            # engine must not additionally slice it
+            self.accumulate_steps = 1
 
         glb = dict(self.cfg.get("Global") or {})
         self.seed = int(glb.get("seed", 1234))
         self._base_rng = jax.random.PRNGKey(self.seed)
+
+        # profiler window (reference Profiler: config block + paddle.profiler
+        # integration, eager_engine.py:197-219,329-330,679-738)
+        prof = dict(self.cfg.get("Profiler") or {})
+        self.profiler_enabled = bool(prof.get("enable"))
+        sched = list(prof.get("scheduler") or [])
+        self.profiler_start = _int(prof, "start_step",
+                                   int(sched[0]) if sched else 3)
+        self.profiler_stop = _int(prof, "stop_step",
+                                  int(sched[1]) if len(sched) > 1
+                                  else self.profiler_start + 5)
+        self.profiler_dir = (prof.get("output_dir")
+                             or prof.get("profiler_log") or "./profiler_log")
+        self._profiling = False
 
         self.optimizer = optimizer
         self.lr_schedule = lr_schedule
@@ -226,6 +246,7 @@ class EagerEngine:
             new_params = optax.apply_updates(state.params, updates)
 
             new_scaler = state.scaler
+            new_step = state.step + 1
             if use_scaler:
                 finite = jnp.isfinite(grad_norm)
                 # skip the update on overflow; grow/backoff the scale
@@ -247,8 +268,15 @@ class EagerEngine:
                 new_scaler = ScalerState(loss_scale=scale,
                                          growth_tracker=jnp.where(grow, 0, tracker))
                 metrics["loss_scale"] = scale
+                # a skipped (overflowed) step must not advance the LR
+                # schedule / dropout fold-in (reference GradScaler semantics)
+                new_step = state.step + jnp.where(finite, 1, 0).astype(state.step.dtype)
 
-            return TrainState(step=state.step + 1, params=new_params,
+            # let the host resync its step mirror at logging points (the fp16
+            # scaler skips step increments on overflow)
+            metrics["opt_step"] = new_step
+
+            return TrainState(step=new_step, params=new_params,
                               opt_state=new_opt, scaler=new_scaler), metrics
 
         def eval_step(state: TrainState, batch: dict):
@@ -306,9 +334,15 @@ class EagerEngine:
             window = 0
             losses = []
             step = start_step  # host-side mirror of state.step (no per-step sync)
+            last_eval = last_save = -1  # fp16 resync can re-visit a step
             for batch in batches():
                 if step >= self.max_steps:
                     break
+                if self.profiler_enabled and not self._profiling and \
+                        step >= self.profiler_start:
+                    jax.profiler.start_trace(self.profiler_dir)
+                    self._profiling = True
+                    logger.info("profiler trace started → %s", self.profiler_dir)
                 sharded = self.shard_batch(batch)
                 self.state, metrics = self._train_step(self.state, sharded)
                 window += 1
@@ -316,6 +350,9 @@ class EagerEngine:
                 step += 1
                 if window % self.logging_freq == 0:
                     metrics = jax.device_get(metrics)
+                    # resync with the device step counter: under the fp16
+                    # scaler, overflowed steps don't advance state.step
+                    step = int(metrics.get("opt_step", step))
                     now = time.time()
                     cost = (now - t_last) / self.logging_freq
                     t_last = now
@@ -327,11 +364,23 @@ class EagerEngine:
                         "global_batch_size": global_batch,
                         "lr": float(metrics.get("lr", 0.0)),
                     })
+                if self._profiling and step >= self.profiler_stop:
+                    jax.block_until_ready(metrics.get("loss"))
+                    jax.profiler.stop_trace()
+                    self._profiling = False
+                    self.profiler_enabled = False  # one window per fit
+                    logger.info("profiler trace written to %s", self.profiler_dir)
                 if self.eval_freq and valid_data_loader is not None and \
-                        step % self.eval_freq == 0:
+                        step % self.eval_freq == 0 and step != last_eval:
+                    last_eval = step
                     self.evaluate(valid_data_loader, global_step=step)
-                if self.save_steps and step % self.save_steps == 0:
+                if self.save_steps and step % self.save_steps == 0 and \
+                        step != last_save:
+                    last_save = step
                     self.save()
+            if self._profiling:
+                jax.profiler.stop_trace()
+                self._profiling = False
             return losses
 
     # ---------------------------------------------------------------- eval
@@ -355,6 +404,18 @@ class EagerEngine:
                 "loss": total / count, "eval_cost": (time.time() - t0) / count,
             })
         return total / max(count, 1)
+
+    # ------------------------------------------------------------ inference
+    def inference(self, data: list):
+        """Delegate to the AOT ``InferenceEngine`` (reference
+        ``eager_engine.py:671-677``): first call loads ``Inference.model_dir``."""
+        if getattr(self, "_inference_engine", None) is None:
+            from fleetx_tpu.core.engine.inference_engine import InferenceEngine
+
+            inf = dict(self.cfg.get("Inference") or {})
+            self._inference_engine = InferenceEngine(
+                inf.get("model_dir", "./exported"))
+        return self._inference_engine.predict(data)
 
     # ---------------------------------------------------------- checkpoints
     def save(self):

@@ -79,19 +79,40 @@ class LanguageModule(BasicModule):
 
     tokens_per_sample: int = 1024
 
+    def flops_per_token(self) -> float | None:
+        """fwd+bwd model FLOPs per trained token (for the MFU line)."""
+        from fleetx_tpu.utils.hardware import gpt_flops_per_token
+
+        c = getattr(self, "model_cfg", None)
+        if c is None:
+            return None
+        return gpt_flops_per_token(c.num_layers, c.hidden_size,
+                                   self.tokens_per_sample,
+                                   vocab_size=c.vocab_size)
+
     def training_step_end(self, log_dict: dict) -> None:
         speed = 1.0 / max(log_dict.get("train_cost", 1e-9), 1e-9)
         default_global_tokens_num = log_dict.get(
             "global_batch_size", log_dict.get("batch_size", 1)) * self.tokens_per_sample
+        mfu = ""
+        fpt = self.flops_per_token()
+        if fpt:
+            from fleetx_tpu.utils.hardware import peak_flops
+
+            peak = peak_flops(jax.devices()[0])
+            if peak:
+                util = (fpt * default_global_tokens_num * speed
+                        / (peak * max(self.nranks, 1)))
+                mfu = f", mfu: {util:.1%}"
         logger.info(
             "[train] global step %d, epoch: %d, batch: %d, loss: %.9f, "
             "avg_batch_cost: %.5f sec, speed: %.2f step/s, "
-            "ips_total: %.0f tokens/s, ips: %.0f tokens/s, learning rate: %.5e",
+            "ips_total: %.0f tokens/s, ips: %.0f tokens/s, learning rate: %.5e%s",
             log_dict["global_step"], log_dict.get("epoch", 0), log_dict["batch"],
             log_dict["loss"], log_dict.get("train_cost", 0.0), speed,
             default_global_tokens_num * speed,
             default_global_tokens_num * speed / max(self.nranks, 1),
-            log_dict.get("lr", 0.0))
+            log_dict.get("lr", 0.0), mfu)
 
     def validation_step_end(self, log_dict: dict) -> None:
         speed = 1.0 / max(log_dict.get("eval_cost", 1e-9), 1e-9)
@@ -115,8 +136,27 @@ class GPTModule(LanguageModule):
     def __init__(self, cfg: Any):
         from fleetx_tpu.models.gpt.model import config_from_dict
 
-        model_cfg = cfg.get("Model", cfg) if isinstance(cfg, dict) else cfg
-        self.model_cfg = config_from_dict(dict(model_cfg))
+        model_cfg = dict(cfg.get("Model", cfg)) if isinstance(cfg, dict) else dict(cfg)
+        if isinstance(cfg, dict):
+            # pipeline topology flows from the Distributed section (reference
+            # pp_degree, utils/config.py:30-65); microbatch count from the
+            # engine's accumulate_steps (reference pipeline micro-batching,
+            # language_module.py:155-161 + config.py:117)
+            dist = dict(cfg.get("Distributed") or {})
+            eng = dict(cfg.get("Engine") or {})
+            pp = int(dist.get("pp_degree") or 1)
+            if pp > 1 and not model_cfg.get("pp_degree"):
+                model_cfg["pp_degree"] = pp
+            if int(model_cfg.get("pp_degree") or 1) > 1 and \
+                    not model_cfg.get("pp_microbatches"):
+                model_cfg["pp_microbatches"] = int(eng.get("accumulate_steps") or 0)
+            # QAT wrap (reference language_module.py:142-144)
+            quant = dict(cfg.get("Quantization") or {})
+            if quant.get("enable"):
+                model_cfg["use_qat"] = True
+                if quant.get("weight_bits"):
+                    model_cfg["qat_bits"] = int(quant["weight_bits"])
+        self.model_cfg = config_from_dict(model_cfg)
         self.tokens_per_sample = self.model_cfg.max_position_embeddings
         super().__init__(cfg)
         logger.info(

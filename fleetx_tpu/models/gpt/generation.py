@@ -84,6 +84,39 @@ def forced_eos_processor(max_length: int, eos_token_id: int):
     return apply
 
 
+def hamming_diversity_processor(diversity_rate: float, num_beams: int,
+                                num_beam_groups: int):
+    """Group beam-search diversity penalty (reference
+    ``HammingDiversityLogitsProcessor``, ``processor.py``): subtract
+    ``diversity_rate`` × (token frequency among earlier groups' current
+    tokens) from the CURRENT group's logits.
+
+    ``apply(logits, current_tokens, beam_group_idx)`` — ``logits`` holds the
+    current group's rows ``[batch*group_size, vocab]`` while
+    ``current_tokens`` spans all beams ``[batch*num_beams]`` (reference
+    calling convention).
+    """
+    group_size = num_beams // num_beam_groups
+
+    def apply(logits, current_tokens, beam_group_idx):
+        if diversity_rate == 0.0:
+            return logits
+        vocab = logits.shape[-1]
+        batch = current_tokens.shape[0] // num_beams
+        group_start = beam_group_idx * group_size
+        # tokens already chosen this step by PREVIOUS groups, per batch row
+        toks = current_tokens.reshape(batch, num_beams)
+        pos = jnp.arange(num_beams)[None, :]
+        valid = pos < group_start
+        freq = jnp.zeros((batch, vocab), logits.dtype)
+        ones = jnp.where(valid, 1.0, 0.0).astype(logits.dtype)
+        freq = freq.at[jnp.arange(batch)[:, None], toks].add(ones)
+        penalty = diversity_rate * jnp.repeat(freq, group_size, axis=0)
+        return logits - penalty
+
+    return apply
+
+
 # --------------------------------------------------------------------------
 # sampling transforms (reference sample(), hybrid_model.py:1280-1300)
 # --------------------------------------------------------------------------

@@ -58,6 +58,11 @@ class GPTConfig:
     use_flash_attention: bool = True
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
     sequence_parallel: bool = False
+    use_ring_attention: bool = False  # context parallelism over the seq axis
+    use_qat: bool = False      # int8 fake-quant on linears (ops/quantization.py)
+    qat_bits: int = 8
+    pp_degree: int = 1         # pipeline stages (reference pp_degree)
+    pp_microbatches: int = 0   # 0 → defaults to pp_degree (ref accumulate_steps)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -129,7 +134,15 @@ class MultiHeadAttention(nn.Module):
             (h,), cfg.param_dtype)
 
         x = x.astype(cfg.dtype)
-        qkv = jnp.einsum("bsh,hcnd->bcsnd", x, qkv_kernel.astype(cfg.dtype))
+        qkv_k = qkv_kernel.astype(cfg.dtype)
+        if cfg.use_qat:
+            # QAT (reference language_module.py:142-144): fake-quant the
+            # matmul operands; per-channel scales over the input dim
+            from fleetx_tpu.ops.quantization import fake_quant
+
+            x = fake_quant(x, cfg.qat_bits)
+            qkv_k = fake_quant(qkv_k, cfg.qat_bits, axis=0)
+        qkv = jnp.einsum("bsh,hcnd->bcsnd", x, qkv_k)
         qkv = qkv + qkv_bias.astype(cfg.dtype)[:, None, :, :]
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, s, n, d]
         q = with_logical(q, ("batch", "act_seq", "act_heads", "act_kv"))
@@ -145,6 +158,10 @@ class MultiHeadAttention(nn.Module):
             cv = jax.lax.dynamic_update_slice_in_dim(layer_cache["value"], v, idx, axis=1)
             cm = jax.lax.dynamic_update_slice_in_dim(layer_cache["mask"], step_mask,
                                                      idx, axis=1)
+            # keep the rolling cache TP-sharded over heads through the decode
+            # loop (SURVEY hard-part 5: kv-cache sharding under TP)
+            ck = with_logical(ck, ("batch", None, "act_heads", "act_kv"))
+            cv = with_logical(cv, ("batch", None, "act_heads", "act_kv"))
             new_cache = {"key": ck, "value": cv, "index": idx + x.shape[1],
                          "mask": cm}
             k, v = ck, cv
@@ -154,7 +171,13 @@ class MultiHeadAttention(nn.Module):
         else:
             attn_out = self._core_attn(q, k, v, deterministic)
 
-        out = jnp.einsum("bsnd,ndh->bsh", attn_out, out_kernel.astype(cfg.dtype))
+        out_k = out_kernel.astype(cfg.dtype)
+        if cfg.use_qat:
+            from fleetx_tpu.ops.quantization import fake_quant
+
+            attn_out = fake_quant(attn_out, cfg.qat_bits)
+            out_k = fake_quant(out_k, cfg.qat_bits, axis=(0, 1))
+        out = jnp.einsum("bsnd,ndh->bsh", attn_out, out_k)
         out = out + out_bias.astype(cfg.dtype)
         return out, new_cache
 
@@ -175,7 +198,15 @@ class MultiHeadAttention(nn.Module):
             return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
         fn = plain
-        if cfg.use_flash_attention:
+        if cfg.use_ring_attention:
+            # context parallelism: K/V ring over the seq mesh axis
+            # (ops/ring_attention.py — capability beyond the reference)
+            from fleetx_tpu.ops import ring_attention as ra
+
+            assert cfg.attention_probs_dropout_prob == 0.0 or deterministic, \
+                "ring attention does not support attention dropout"
+            fn = partial(ra.ring_attention, causal=True)
+        elif cfg.use_flash_attention:
             from fleetx_tpu.ops import flash_attention
             rate = 0.0 if deterministic else cfg.attention_probs_dropout_prob
             if flash_attention.supported(q, k) and (
@@ -239,10 +270,21 @@ class GPTMlp(nn.Module):
         bo = self.param("wo_bias", param_with_axes(nn.initializers.zeros, ("embed",)),
                         (cfg.hidden_size,), cfg.param_dtype)
         x = x.astype(cfg.dtype)
-        y = jnp.einsum("bsh,hm->bsm", x, wi.astype(cfg.dtype)) + bi.astype(cfg.dtype)
+        wi_k, wo_k = wi.astype(cfg.dtype), wo.astype(cfg.dtype)
+        if cfg.use_qat:
+            from fleetx_tpu.ops.quantization import fake_quant
+
+            x = fake_quant(x, cfg.qat_bits)
+            wi_k = fake_quant(wi_k, cfg.qat_bits, axis=0)
+            wo_k = fake_quant(wo_k, cfg.qat_bits, axis=0)
+        y = jnp.einsum("bsh,hm->bsm", x, wi_k) + bi.astype(cfg.dtype)
         y = with_logical(y, ("batch", "act_seq", "mlp"))
         y = nn.gelu(y, approximate=True)
-        return jnp.einsum("bsm,mh->bsh", y, wo.astype(cfg.dtype)) + bo.astype(cfg.dtype)
+        if cfg.use_qat:
+            from fleetx_tpu.ops.quantization import fake_quant
+
+            y = fake_quant(y, cfg.qat_bits)
+        return jnp.einsum("bsm,mh->bsh", y, wo_k) + bo.astype(cfg.dtype)
 
 
 class LayerNorm(nn.Module):
@@ -352,10 +394,32 @@ class GPTModel(nn.Module):
 
         layer = TransformerDecoderLayer
         if cfg.use_recompute and cfg.recompute_granularity == "full" and cache is None:
+            # deterministic/attention_mask are control flags, not data — keep
+            # them static under remat (with dropout>0 they'd otherwise be
+            # traced and break `not deterministic`)
             layer = nn.remat(layer, prevent_cse=False,
-                             policy=jax.checkpoint_policies.nothing_saveable)
+                             policy=jax.checkpoint_policies.nothing_saveable,
+                             static_argnums=(3, 4))
 
-        if cfg.scan_layers:
+        if cfg.pp_degree > 1 and cache is None:
+            # pipeline-parallel stack (reference GPTForPretrainingPipe,
+            # hybrid_model.py:862-962 → parallel/pipeline.py). Flash attention
+            # is a custom call GSPMD cannot partition over the vmapped stage
+            # axis, so the pipelined stack uses the XLA attention path.
+            from fleetx_tpu.parallel.pipeline import (
+                make_stage_stack, pipeline_apply)
+
+            assert attention_mask is None, "pipeline mode is training-only"
+            assert cfg.num_layers % cfg.pp_degree == 0
+            pcfg = dataclasses.replace(cfg, use_flash_attention=False)
+            stages = make_stage_stack(
+                layer, cfg.pp_degree,
+                cfg.num_layers // cfg.pp_degree)(pcfg, name="layers")
+            x = pipeline_apply(stages, x, cfg.pp_degree,
+                               cfg.pp_microbatches or cfg.pp_degree,
+                               deterministic=deterministic)
+            new_cache = None
+        elif cfg.scan_layers:
             layer_caches = None
             if cache is not None:
                 layer_caches = {

@@ -1,0 +1,39 @@
+"""Quantization-aware training — int8 fake-quant with straight-through grads.
+
+Reference: ``ppfleetx/models/language_model/language_module.py:142-144`` wraps
+the model with ``paddleslim.dygraph.quant.QAT`` (simulated int8 on linear
+weights + activations, ``pretrain_gpt_345M_mp8_qat.yaml``). The functional
+equivalent: symmetric fake-quantisation applied to each matmul's kernel
+(per-output-channel scales) and input activations (per-tensor scale), with
+the straight-through estimator so gradients flow as if quantisation were
+identity. XLA folds the quant/dequant pair into the surrounding fusion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fake_quant", "quantize_weight", "quantize_act"]
+
+
+def fake_quant(x: jax.Array, bits: int = 8, axis=None) -> jax.Array:
+    """Simulated symmetric quantisation with straight-through gradients."""
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+    scale = jnp.maximum(scale / qmax, 1e-8).astype(x.dtype)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax) * scale
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def quantize_weight(w: jax.Array, bits: int = 8,
+                    out_axis: int = -1) -> jax.Array:
+    """Per-output-channel weight fake-quant (paddleslim 'channel_wise_abs_max')."""
+    axes = tuple(i for i in range(w.ndim) if i != (out_axis % w.ndim))
+    return fake_quant(w, bits=bits, axis=axes)
+
+
+def quantize_act(x: jax.Array, bits: int = 8) -> jax.Array:
+    """Per-tensor activation fake-quant (paddleslim 'moving_average_abs_max'
+    collapses to abs-max under jit: the scale is recomputed per step)."""
+    return fake_quant(x, bits=bits, axis=None)

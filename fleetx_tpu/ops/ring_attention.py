@@ -1,0 +1,94 @@
+"""Ring attention over the ``seq`` mesh axis — long-context parallelism.
+
+The reference tops out at Megatron-SP over the TP group (activations
+scattered 1/mp along sequence between blocks,
+``ppfleetx/models/language_model/gpt/dygraph/sequence_parallel_utils.py:150-326``)
+and trains seq_len 1024; it has NO ring/context/blockwise attention anywhere
+(SURVEY.md §5). This module is the idiomatic TPU superset: sequence-sharded
+attention where K/V blocks rotate around the ``seq`` ring via
+``lax.ppermute`` (one ICI hop per step) while each device folds the incoming
+block into an online-softmax accumulator — flash attention's streaming
+update, distributed.
+
+Written as a *partial-manual* ``jax.shard_map``: only ``seq`` is manual, so
+GSPMD still handles dp/fsdp/tensor sharding of the same operands inside the
+body. Causality with contiguous block sharding means block ``j`` contributes
+to queries of block ``i`` only when ``j <= i``; later blocks are masked (the
+compute is uniform across ring steps — the standard ring-attention bubble).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_local"]
+
+_NEG_INF = -1e30
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         axis_name: str = "seq", causal: bool = True) -> jax.Array:
+    """Per-device body; call inside ``shard_map`` with ``axis_name`` manual.
+
+    q/k/v: [batch, s_local, heads, head_dim] — the local sequence block.
+    Returns the exact softmax(QK^T)V rows for the local queries.
+    """
+    ring = lax.static_axis_size(axis_name) if hasattr(lax, "static_axis_size") \
+        else lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, n, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+
+    q32 = q.astype(jnp.float32)
+    qpos = me * s_loc + jnp.arange(s_loc)
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, o = carry
+        j = (me - t) % ring  # whose block we hold at step t
+        s = jnp.einsum("bqnd,bknd->bnqk", q32, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            kpos = j * s_loc + jnp.arange(s_loc)
+            mask = kpos[None, :] <= qpos[:, None]  # [q, k]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bnqk,bknd->bnqd", p, v_cur.astype(jnp.float32))
+        perm = [(r, (r + 1) % ring) for r in range(ring)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, n, s_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, s_loc), jnp.float32)
+    o0 = jnp.zeros((b, n, s_loc, d), jnp.float32)
+    (_, _, _, l, o), _ = lax.scan(step, (k, v, m0, l0, o0),
+                                  jnp.arange(ring))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   causal: bool = True, axis_name: str = "seq",
+                   mesh=None) -> jax.Array:
+    """Sequence-parallel attention: q/k/v ``[b, s, n, d]`` with ``s`` sharded
+    over ``axis_name``. Must run inside jit under the mesh context (the
+    engine's ``_ctx``); all other axes stay GSPMD-automatic."""
+    if mesh is None:
+        from fleetx_tpu.parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+    assert mesh is not None, "ring_attention needs an ambient or explicit mesh"
+    spec = P(None, axis_name)
+    fn = jax.shard_map(
+        partial(ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis_name}), check_vma=False)
+    return fn(q, k, v)

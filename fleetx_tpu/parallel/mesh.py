@@ -103,3 +103,14 @@ def get_mesh() -> Mesh:
     if _global_mesh is None:
         _global_mesh = build_mesh()
     return _global_mesh
+
+
+def current_mesh() -> Mesh | None:
+    """The ambient mesh: the ``with mesh:`` context (what the engine and
+    flax logical rules use), else the process-global one."""
+    from jax._src import mesh as mesh_lib
+
+    m = mesh_lib.thread_resources.env.physical_mesh
+    if m is not None and not m.empty:
+        return m
+    return _global_mesh

@@ -1,0 +1,138 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis — GSPMD-native GPipe.
+
+Reference: ``ppfleetx/models/language_model/gpt/dygraph/hybrid_model.py:862-962``
+(``GPTForPretrainingPipe``: ``LayerDesc`` stage partitioning, shared
+first/last-stage embedding) executed by paddle's 1F1B ``train_batch``
+(``ppfleetx/core/engine/eager_engine.py:400-410``) with explicit P2P
+send/recv between stage ranks.
+
+The TPU re-design needs none of that machinery:
+
+- **Stage partitioning** is a reshape: the scanned layer stack's parameters
+  gain a leading ``[num_stages, layers_per_stage]`` shape (``nn.vmap`` over
+  stages of ``nn.scan`` over layers) whose stage axis is sharded over the
+  ``pipe`` mesh axis by the logical rule ``pipe_stage → pipe``.
+- **The schedule** is a ``lax.scan`` over ``M + S - 1`` iterations carrying a
+  ``[S, microbatch, ...]`` ``shift`` buffer, also sharded over ``pipe``.
+  Each iteration every stage applies its own layers to its current
+  microbatch; ``jnp.roll`` on the stage axis hands activations to the next
+  stage — XLA lowers the roll of a pipe-sharded buffer to a single ICI
+  collective-permute, which IS the reference's P2P send/recv.
+- **Backward** needs no hand-written 1F1B: differentiating through the
+  iteration scan replays the schedule in reverse (activations bounded by
+  per-layer remat, ``use_recompute``).
+- **Shared embeddings** (reference ``SharedLayerDesc`` + weight-sync
+  allreduce) vanish: the tied embedding table is simply *used* twice —
+  GSPMD replicates it over ``pipe`` and inserts the gradient psum.
+
+The first ``S - 1`` and last ``S - 1`` iterations are ramp-up/ramp-down
+bubbles computing on zero blocks; their outputs are dropped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Type
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["make_stage_stack", "pipeline_apply"]
+
+
+def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
+                     layers_per_stage: int) -> Type[nn.Module]:
+    """Stage-stacked layer module: params ``[num_stages, layers_per_stage, ...]``.
+
+    The inner ``nn.scan`` runs one stage's layers sequentially (axis name
+    ``layers``, same as the non-pipelined stack); the outer ``nn.vmap`` adds
+    the stage axis (name ``pipe_stage``, sharded over ``pipe`` by the rule
+    table). Tree paths are identical to the non-pipelined stack — only the
+    leading dims differ (``[L] → [S, L/S]``).
+    """
+    stage = nn.scan(
+        layer_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+        out_axes=0,
+        length=layers_per_stage,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )
+    return nn.vmap(
+        stage,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=(0, None, None, None),
+        out_axes=0,
+        metadata_params={nn.PARTITION_NAME: "pipe_stage"},
+    )
+
+
+def _constrain(x: jnp.ndarray, axes: tuple) -> jnp.ndarray:
+    return nn.with_logical_constraint(x, axes)
+
+
+def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
+                   num_microbatches: int, deterministic: bool = True) -> jnp.ndarray:
+    """Run a batch through the stage stack on the GPipe microbatch schedule.
+
+    Must be called from the parent module's compact scope. ``x`` is the
+    embedded batch ``[B, seq, hidden]``; it is split into
+    ``num_microbatches`` microbatches that flow through the stages.
+    """
+    S, M = num_stages, num_microbatches
+    batch = x.shape[0]
+    if batch % M:
+        # only param-init traces (single sample) may bypass microbatching;
+        # a real batch that doesn't divide is a config error, not something
+        # to silently degrade the schedule over
+        assert batch == 1, (
+            f"batch {batch} not divisible by pp_microbatches {M}")
+        M = 1
+    mb = batch // M
+    rest = x.shape[1:]
+    act_axes = ("batch", "act_seq", "act_embed")
+
+    micro = x.reshape((M, mb) + rest)
+    # bubble padding: the last S-1 iterations drain the pipe with zero inputs
+    stream = jnp.concatenate(
+        [micro, jnp.zeros((S - 1, mb) + rest, x.dtype)], axis=0)
+    stream = _constrain(stream, (None,) + act_axes)
+
+    def iteration(mod, shift, x_in):
+        # stage 0 ingests the next microbatch; stages 1..S-1 keep what the
+        # previous iteration's roll handed them
+        shift = shift.at[0].set(x_in)
+        shift = _constrain(shift, ("act_stage",) + act_axes)
+        out, _ = mod(shift, None, deterministic, None)
+        out = _constrain(out, ("act_stage",) + act_axes)
+        y_last = out[-1]                    # drain from the final stage
+        new_shift = jnp.roll(out, 1, axis=0)  # ICI collective-permute
+        return new_shift, y_last
+
+    run = nn.scan(
+        iteration,
+        variable_broadcast="params",
+        split_rngs={"params": False, "dropout": True},
+        length=M + S - 1,
+        in_axes=0,
+        out_axes=0,
+    )
+    shift0 = jnp.zeros((S, mb) + rest, x.dtype)
+    _, ys = run(stages, shift0, stream)
+    # iteration t drains microbatch t-(S-1); drop the S-1 ramp-up bubbles
+    out = ys[S - 1:]
+    return _constrain(out.reshape((batch,) + rest), act_axes)
+
+
+def split_stage_params(stack_params: Any, num_stages: int) -> Any:
+    """Reshape a non-pipelined layer stack's params ``[L, ...]`` into the
+    pipelined layout ``[S, L/S, ...]`` (tree paths are identical)."""
+    import jax
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        assert L % num_stages == 0
+        return leaf.reshape((num_stages, L // num_stages) + leaf.shape[1:])
+
+    return jax.tree.map(reshape, stack_params)

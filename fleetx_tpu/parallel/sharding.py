@@ -51,6 +51,8 @@ def make_axis_rules(dist_config: dict | None = None) -> tuple[tuple[str, Any], .
         ("heads", "tensor"),
         ("kv", None),
         ("layers", None),
+        ("pipe_stage", "pipe"),
+        ("act_stage", "pipe"),
         ("norm", None),
         ("embed", "fsdp" if stage >= 3 else None),
         ("act_seq", act_seq),

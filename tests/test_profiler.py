@@ -1,0 +1,49 @@
+"""Config-gated jax.profiler trace window (reference Profiler: block,
+``eager_engine.py:197-219,329-330``)."""
+
+import os
+
+import numpy as np
+
+from fleetx_tpu.core.engine import EagerEngine
+from fleetx_tpu.core.module import GPTModule
+from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+from fleetx_tpu.optims.optimizer import build_optimizer
+from fleetx_tpu.parallel.mesh import build_mesh
+
+VOCAB, SEQ, BATCH = 64, 16, 4
+
+
+def test_profiler_trace_window(tmp_path, devices8):
+    out = str(tmp_path / "prof")
+    cfg = {
+        "Model": dict(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                      num_attention_heads=2, max_position_embeddings=SEQ,
+                      hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                      use_flash_attention=False, dtype="float32",
+                      param_dtype="float32"),
+        "Engine": {"max_steps": 4, "logging_freq": 1},
+        "Global": {"seed": 0},
+        "Profiler": {"enable": True, "start_step": 1, "stop_step": 2,
+                     "output_dir": out},
+    }
+    module = GPTModule(cfg)
+    lr = build_lr_scheduler({"max_lr": 1e-3, "warmup_steps": 1,
+                             "decay_steps": 10})
+    opt = build_optimizer({"name": "AdamW"}, lr)
+    eng = EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr,
+                      mesh=build_mesh({}, devices=devices8[:1]))
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+    batch = {"tokens": tokens,
+             "position_ids": np.broadcast_to(
+                 np.arange(SEQ, dtype=np.int32), (BATCH, SEQ)).copy(),
+             "labels": tokens,
+             "loss_mask": np.ones((BATCH, SEQ), np.float32)}
+    losses = eng.fit([batch] * 4)
+    assert len(losses) == 4 and all(np.isfinite(losses))
+    assert not eng._profiling
+    # a trace was written inside the window
+    found = [f for _, _, fs in os.walk(out) for f in fs]
+    assert found, f"no profiler output under {out}"

@@ -1,0 +1,124 @@
+"""ZeRO-3 param sharding + tensor-parallel generation (VERDICT r2 gaps).
+
+- stage-3 engine: params themselves sharded over fsdp on-mesh, loss parity
+  with the single-device run (the reference's ``group_sharded_parallel``
+  level="p_g_os", ``eager_engine.py:228-242``).
+- generation on a tp2 mesh: greedy decode (kv cache sharded over heads)
+  reproduces the single-device token sequence (SURVEY hard-part 5).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.core import meta
+
+from fleetx_tpu.core.engine import EagerEngine
+from fleetx_tpu.core.module import GPTModule
+from fleetx_tpu.models.gpt import generation as G
+from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
+from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+from fleetx_tpu.optims.optimizer import build_optimizer
+from fleetx_tpu.parallel.mesh import build_mesh
+from fleetx_tpu.parallel.sharding import make_axis_rules
+
+VOCAB, SEQ, BATCH = 128, 32, 8
+
+
+def _cfg(**dist):
+    cfg = {
+        "Model": dict(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_attention_heads=4, max_position_embeddings=SEQ,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      use_flash_attention=False, dtype="float32",
+                      param_dtype="float32"),
+        "Engine": {"max_steps": 3, "logging_freq": 1},
+        "Global": {"seed": 7},
+    }
+    if dist:
+        cfg["Distributed"] = dist
+    return cfg
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        tokens = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+        out.append({
+            "tokens": tokens,
+            "position_ids": np.broadcast_to(np.arange(SEQ, dtype=np.int32),
+                                            (BATCH, SEQ)).copy(),
+            "labels": np.roll(tokens, -1, axis=1),
+            "loss_mask": np.ones((BATCH, SEQ), np.float32)})
+    return out
+
+
+def _run(cfg, mesh, n=3):
+    module = GPTModule(cfg)
+    lr = build_lr_scheduler({"name": "cosine", "max_lr": 1e-3, "min_lr": 1e-4,
+                             "warmup_steps": 2, "decay_steps": 100})
+    opt = build_optimizer({"name": "AdamW", "weight_decay": 0.01,
+                           "grad_clip": {"clip_norm": 1.0}}, lr)
+    eng = EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr, mesh=mesh)
+    eng.max_steps = n
+    return eng, eng.fit(_batches(n))
+
+
+def _spec_axes(arr):
+    axes = set()
+    for entry in arr.sharding.spec:
+        if isinstance(entry, (tuple, list)):
+            axes.update(entry)
+        elif entry is not None:
+            axes.add(entry)
+    return axes
+
+
+def test_zero_stage3_shards_params_with_loss_parity(devices8):
+    _, ref = _run(_cfg(), build_mesh({}, devices=devices8[:1]))
+
+    cfg = _cfg(fsdp_degree=4, dp_degree=2, sharding={"sharding_stage": 3})
+    mesh = build_mesh(cfg["Distributed"], devices=devices8)
+    eng, got = _run(cfg, mesh)
+
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    # stage 3: embed-dim kernels sharded over fsdp ON the mesh
+    sharded = [l for l in jax.tree.leaves(eng.state.params)
+               if "fsdp" in _spec_axes(l)]
+    assert sharded, "no parameter sharded over fsdp at stage 3"
+    # optimizer state follows the params
+    opt_sharded = [l for l in jax.tree.leaves(eng.state.opt_state)
+                   if hasattr(l, "sharding") and "fsdp" in _spec_axes(l)]
+    assert opt_sharded, "no optimizer-state leaf sharded over fsdp at stage 3"
+
+
+def test_generation_parity_on_tp_mesh(devices8):
+    """Greedy decode on a tp2×dp2 mesh == single-device decode."""
+    model_cfg = GPTConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                          num_attention_heads=4, max_position_embeddings=64,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0,
+                          use_flash_attention=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32)
+    model = GPTForPretraining(model_cfg)
+    prompts = [[5, 6, 7], [9, 10, 11, 12]]
+    tokens, mask = G.left_pad(prompts, 0)
+    params = meta.unbox(model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.asarray(tokens),
+        None, deterministic=True)["params"])
+    gen_cfg = G.GenerationConfig(max_new_tokens=8, do_sample=False,
+                                 eos_token_id=-1, pad_token_id=0)
+    rng = jax.random.PRNGKey(0)
+    want = np.asarray(G.generate(model, params, gen_cfg, jnp.asarray(tokens),
+                                 jnp.asarray(mask), rng))
+
+    dist = {"mp_degree": 2, "dp_degree": 2, "fsdp_degree": 2}
+    mesh = build_mesh(dist, devices=devices8)
+    rules = make_axis_rules(dist)
+    with mesh, nn.logical_axis_rules(rules):
+        got = np.asarray(jax.jit(
+            lambda p, t, m: G.generate(model, p, gen_cfg, t, m, rng))(
+            params, jnp.asarray(tokens), jnp.asarray(mask)))
+    np.testing.assert_array_equal(got, want)
