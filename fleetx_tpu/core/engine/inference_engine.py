@@ -1,0 +1,36 @@
+"""AOT inference engine — the ``paddle.inference`` analogue.
+
+Reference: ``ppfleetx/core/engine/inference_engine.py:73-197`` loads a
+per-rank exported static program, wires an NCCL ring for mp>1, and runs a
+predictor handle-by-handle. The TPU equivalent is radically smaller: the
+exported artifact is a serialized StableHLO module (``utils/export.py``)
+that XLA AOT-compiles once at load; tensor-parallel inference needs no ring
+CSV because the module runs under whatever mesh the caller provides.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from fleetx_tpu.utils.export import load_exported
+from fleetx_tpu.utils.log import logger
+
+
+class InferenceEngine:
+    """Runs an exported model directory (reference ``predict``, l.178-197)."""
+
+    def __init__(self, model_dir: str):
+        self.model_dir = model_dir
+        self.exported, self.params = load_exported(model_dir)
+        self._call = jax.jit(self.exported.call)
+        logger.info("loaded exported model from %s", model_dir)
+
+    def predict(self, inputs: Sequence[Any]) -> list[np.ndarray]:
+        """numpy in → numpy out (reference keeps the same contract)."""
+        arrays = [np.asarray(x) for x in inputs]
+        out = self._call(self.params, *arrays)
+        leaves = jax.tree.leaves(out)
+        return [np.asarray(jax.device_get(l)) for l in leaves]

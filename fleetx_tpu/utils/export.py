@@ -1,0 +1,77 @@
+"""Model export — the ``paddle.jit.to_static`` analogue, done the XLA way.
+
+Reference: ``ppfleetx/utils/export.py:301-336`` traces the dygraph model to a
+static program and writes ``.pdmodel``/``.pdiparams``; ``tools/export.py``
+drives it. Here the portable artifact is a serialized ``jax.export`` module
+(StableHLO bytes, multi-platform cpu+tpu) plus the parameter pytree:
+
+    {out_dir}/module.bin     — serialized Exported (deserialize + .call)
+    {out_dir}/params.npz     — flat parameter arrays keyed by tree path
+    {out_dir}/meta.json      — treedef + input signature description
+
+``load_exported`` restores both halves; ``InferenceEngine`` consumes them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+_SEP = "/"
+
+
+def _flatten_params(params: Any) -> dict[str, np.ndarray]:
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for path, leaf in flat:
+        key = _SEP.join(getattr(p, "key", str(getattr(p, "idx", p)))
+                        for p in path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def export_model(fn: Callable, example_args: Sequence[Any], out_dir: str,
+                 params: Any, platforms: Sequence[str] = ("cpu", "tpu")) -> None:
+    """AOT-export ``fn(params, *inputs)`` and save with its parameters."""
+    os.makedirs(out_dir, exist_ok=True)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+        if not isinstance(x, jax.ShapeDtypeStruct) else x,
+        (params,) + tuple(example_args))
+    exp = jax.export.export(jax.jit(fn), platforms=list(platforms))(*abstract)
+    with open(os.path.join(out_dir, "module.bin"), "wb") as f:
+        f.write(exp.serialize())
+    np.savez(os.path.join(out_dir, "params.npz"), **_flatten_params(params))
+    meta = {
+        "in_avals": [str(a) for a in jax.tree.leaves(abstract)],
+        "platforms": list(platforms),
+    }
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    logger.info("exported model to %s (platforms=%s)", out_dir, list(platforms))
+
+
+def _unflatten_params(arrays: dict[str, np.ndarray]) -> Any:
+    tree: dict = {}
+    for key, val in arrays.items():
+        node = tree
+        parts = key.split(_SEP)
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
+def load_exported(out_dir: str) -> tuple[Any, Any]:
+    """→ (exported_module, params). ``exported_module.call(params, *inputs)``."""
+    with open(os.path.join(out_dir, "module.bin"), "rb") as f:
+        exp = jax.export.deserialize(f.read())
+    arrays = np.load(os.path.join(out_dir, "params.npz"))
+    params = _unflatten_params({k: arrays[k] for k in arrays.files})
+    return exp, params

@@ -1,0 +1,48 @@
+"""GPT inference task driver (reference ``tasks/gpt/inference.py:96-122``):
+tokenize a prompt → run the exported generation module → detokenize."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from fleetx_tpu.core.engine.inference_engine import InferenceEngine
+from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+from fleetx_tpu.models.gpt.generation import left_pad
+from fleetx_tpu.utils import config as config_mod
+from fleetx_tpu.utils.log import logger
+
+
+def main():
+    args = config_mod.parse_args("fleetx_tpu gpt inference")
+    cfg = config_mod.get_config(args.config, args.override)
+    inf = dict(cfg.get("Inference") or {})
+    gen = dict(cfg.get("Generation") or {})
+
+    engine = InferenceEngine(inf.get("model_dir", "./exported"))
+    tok_dir = gen.get("tokenizer_dir") or inf.get("tokenizer_dir")
+    tokenizer = GPTTokenizer.from_pretrained(tok_dir) if tok_dir else None
+
+    text = gen.get("input_text", "The quick brown fox")
+    prompt_len = int(inf.get("prompt_len", 128))
+    pad_id = int(gen.get("pad_token_id", 50256))
+    ids = tokenizer.encode(text) if tokenizer else [0]
+    tokens, mask = left_pad([ids], pad_id, width=prompt_len)
+
+    seed = np.zeros((2,), np.uint32)
+    out = engine.predict([tokens, mask, seed])[0]
+    if tokenizer:
+        eos = int(gen.get("eos_token_id", 50256))
+        row = [int(t) for t in out[0]]
+        if eos in row:
+            row = row[:row.index(eos)]
+        logger.info("prompt: %r", text)
+        logger.info("continuation: %r", tokenizer.decode(row))
+    else:
+        logger.info("generated ids: %s", out[0][:32])
+
+
+if __name__ == "__main__":
+    main()

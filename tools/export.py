@@ -1,0 +1,88 @@
+"""Export entry point (reference ``tools/export.py:217-234``).
+
+Usage::
+
+    python tools/export.py -c fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_single_card.yaml \
+        -o Engine.save_load.ckpt_dir=./output
+
+Writes the AOT artifact (serialized StableHLO + params) described in
+``fleetx_tpu/utils/export.py`` to ``Inference.model_dir`` (default
+``./exported``). Targets:
+
+- ``forward``   — logits fn ``(params, tokens, position_ids) → [b,s,vocab]``
+- ``generation``— decode fn ``(params, tokens, mask, rng) → [b, new_tokens]``
+  (picked automatically when the config has a ``Generation`` section)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.core import checkpoint as ckpt_lib
+from fleetx_tpu.models import build_module
+from fleetx_tpu.utils import config as config_mod
+from fleetx_tpu.utils.export import export_model
+from fleetx_tpu.utils.log import logger
+
+
+def load_params(cfg, module):
+    """Restore params-only from the configured checkpoint, else fresh init."""
+    from flax.core import meta
+
+    eng = dict(cfg.get("Engine") or {})
+    ckpt_dir = (dict(eng.get("save_load") or {})).get("ckpt_dir")
+    spec = module.input_spec()
+    sample = {k: jnp.zeros(v.shape, v.dtype) for k, v in spec.items()}
+    params = module.init_variables(jax.random.PRNGKey(0), sample)
+    params = meta.unbox(params)
+    step = ckpt_lib.latest_step(ckpt_dir) if ckpt_dir else None
+    if step is not None:
+        params = ckpt_lib.load_params(ckpt_dir, step)
+        logger.info("restored params from %s step %d", ckpt_dir, step)
+    else:
+        logger.warning("no checkpoint configured/found — exporting fresh init")
+    return params
+
+
+def main():
+    args = config_mod.parse_args("fleetx_tpu export")
+    cfg = config_mod.get_config(args.config, args.override, show=True)
+    module = build_module(cfg)
+    params = load_params(cfg, module)
+
+    inf = dict(cfg.get("Inference") or {})
+    out_dir = inf.get("model_dir", "./exported")
+    target = inf.get("target") or (
+        "generation" if cfg.get("Generation") else "forward")
+
+    if target == "generation":
+        from fleetx_tpu.models.gpt import generation as G
+
+        gen_cfg = module.gen_cfg
+        b = int(inf.get("batch_size", 1))
+        prompt_len = int(inf.get("prompt_len", 128))
+
+        def fn(params, tokens, mask, rng):
+            return G.generate(module.model, params, gen_cfg, tokens, mask, rng)
+
+        example = (jnp.zeros((b, prompt_len), jnp.int32),
+                   jnp.zeros((b, prompt_len), jnp.int32),
+                   jax.random.PRNGKey(0))
+    else:
+        def fn(params, tokens, position_ids):
+            return module.model.apply({"params": params}, tokens, position_ids,
+                                      deterministic=True)
+
+        spec = module.input_spec()
+        example = tuple(spec[k] for k in ("tokens", "position_ids"))
+
+    export_model(fn, example, out_dir, params)
+    logger.info("export done: %s (target=%s)", out_dir, target)
+
+
+if __name__ == "__main__":
+    main()

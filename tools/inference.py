@@ -1,0 +1,44 @@
+"""Inference entry point (reference ``tools/inference.py:163-185``).
+
+Usage::
+
+    python tools/export.py -c <cfg>      # writes Inference.model_dir
+    python tools/inference.py -c <cfg>   # loads it and runs a batch
+
+The reference builds the module, wraps an ``EagerEngine(mode='inference')``
+and loops ``engine.inference(data)``; same shape here, minus the NCCL ring
+bootstrap (the exported module runs under the ambient mesh).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from fleetx_tpu.core.engine.inference_engine import InferenceEngine
+from fleetx_tpu.utils import config as config_mod
+from fleetx_tpu.utils.log import logger
+
+
+def main():
+    args = config_mod.parse_args("fleetx_tpu inference")
+    cfg = config_mod.get_config(args.config, args.override, show=True)
+    inf = dict(cfg.get("Inference") or {})
+    engine = InferenceEngine(inf.get("model_dir", "./exported"))
+
+    # demo batch mirroring the reference's smoke loop (tools/inference.py:178)
+    glb = dict(cfg.get("Global") or {})
+    seq = int(inf.get("prompt_len", glb.get("max_seq_len", 128)))
+    b = int(inf.get("batch_size", 1))
+    tokens = np.zeros((b, seq), np.int32)
+    position_ids = np.broadcast_to(np.arange(seq, dtype=np.int32),
+                                   (b, seq)).copy()
+    outs = engine.predict([tokens, position_ids])
+    for i, o in enumerate(outs):
+        logger.info("output[%d]: shape=%s dtype=%s", i, o.shape, o.dtype)
+
+
+if __name__ == "__main__":
+    main()
