@@ -29,6 +29,11 @@ def get_registry():
         modules["ErnieModule"] = ErnieModule
     except ImportError:
         pass
+    try:
+        from fleetx_tpu.models.imagen.module import ImagenModule
+        modules["ImagenModule"] = ImagenModule
+    except ImportError:
+        pass
     return modules
 
 

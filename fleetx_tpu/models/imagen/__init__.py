@@ -1,0 +1,4 @@
+from fleetx_tpu.models.imagen.modeling import (  # noqa: F401
+    DiffusionConfig, ImagenStage, build_stage)
+from fleetx_tpu.models.imagen.module import ImagenModule  # noqa: F401
+from fleetx_tpu.models.imagen.unet import EfficientUNet, UNetConfig  # noqa: F401

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Imagen base 64² pretraining (reference projects/imagen/*.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/multimodal/imagen/imagen_397M_text2im_64x64.yaml "$@"

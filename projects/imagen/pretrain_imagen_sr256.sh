@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Imagen super-resolution 256² stage (reference projects/imagen/*.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/multimodal/imagen/imagen_super_resolution_256.yaml "$@"

@@ -1,0 +1,115 @@
+"""Imagen cascade: base + SR stages train (loss decreases) and sample.
+
+Reference: ``modeling.py:133-275`` + ``unet.py:814`` — untested upstream;
+here: finite decreasing loss under dp on the CPU mesh for the base stage
+and an SR stage (lowres conditioning), correct-shape CFG sampling, and the
+dataset contract (synthetic + TSV round trip).
+"""
+
+import base64
+import io
+
+import jax
+import numpy as np
+import pytest
+
+from fleetx_tpu.core.engine import EagerEngine
+from fleetx_tpu.data.dataset.multimodal_dataset import (
+    ImagenDataset, SyntheticImagenDataset)
+from fleetx_tpu.models.imagen.module import ImagenModule
+from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+from fleetx_tpu.optims.optimizer import build_optimizer
+from fleetx_tpu.parallel.mesh import build_mesh
+
+BASE_MODEL = dict(
+    module="ImagenModule", image_size=16, dim=16, dim_mults=[1, 2],
+    num_res_blocks=1, layer_attns=[False, True], layer_cross_attns=[False, True],
+    text_embed_dim=24, cond_dim=24, num_attn_heads=2, num_latents=4,
+    timesteps=50, dtype="float32", param_dtype="float32")
+
+
+def _cfg(**model_overrides):
+    model = dict(BASE_MODEL)
+    model.update(model_overrides)
+    return {"Model": model,
+            "Engine": {"max_steps": 6, "logging_freq": 1},
+            "Global": {"seed": 0}}
+
+
+def _collate(ds, idx):
+    keys = ds[0].keys()
+    return {k: np.stack([ds[i][k] for i in idx]) for k in keys}
+
+
+def _train(cfg, mesh, data, n=6):
+    module = ImagenModule(cfg)
+    lr = build_lr_scheduler({"max_lr": 2e-3, "warmup_steps": 1,
+                             "decay_steps": 1000})
+    opt = build_optimizer({"name": "AdamW"}, lr)
+    eng = EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr, mesh=mesh)
+    eng.max_steps = n
+    return module, eng, eng.fit(data)
+
+
+def test_base_stage_trains_dp(devices8):
+    ds = SyntheticImagenDataset(num_samples=64, image_size=16, text_len=6,
+                                text_embed_dim=24)
+    batch = _collate(ds, range(8))
+    cfg = _cfg()
+    cfg["Distributed"] = {"dp_degree": 4}
+    mesh = build_mesh(cfg["Distributed"], devices=devices8[:4])
+    module, eng, losses = _train(cfg, mesh, [batch] * 6)
+    assert all(np.isfinite(losses)), losses
+    # same batch repeated: the stage memorises its noise targets partially
+    assert losses[-1] < losses[0], losses
+
+    # CFG sampling produces [-1,1] images of the right shape
+    from flax.core import meta
+
+    imgs = module.sample_images(eng.state.params, jax.random.PRNGKey(0), 2,
+                                text_embeds=batch["text_embeds"][:2],
+                                text_mask=batch["text_mask"][:2])
+    imgs = np.asarray(imgs)
+    assert imgs.shape == (2, 16, 16, 3)
+    assert np.isfinite(imgs).all() and np.abs(imgs).max() <= 1.0
+
+
+def test_sr_stage_trains_with_lowres_conditioning(devices8):
+    ds = SyntheticImagenDataset(num_samples=64, image_size=16, lowres_size=8,
+                                text_len=6, text_embed_dim=24)
+    batch = _collate(ds, range(4))
+    cfg = _cfg(preset="sr256", dim=16, dim_mults=[1, 2],
+               layer_attns=[False, False], layer_cross_attns=[False, True],
+               lowres_cond=True, lowres_noise_aug=0.1)
+    mesh = build_mesh({}, devices=devices8[:1])
+    _, _, losses = _train(cfg, mesh, [batch] * 5, n=5)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_imagen_tsv_dataset_roundtrip(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    rows = []
+    for i in range(4):
+        img = Image.fromarray(
+            np.random.RandomState(i).randint(0, 255, (20, 20, 3), np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        rows.append(f"caption {i}\t"
+                    + base64.b64encode(buf.getvalue()).decode())
+    tsv = tmp_path / "train.tsv"
+    tsv.write_text("\n".join(rows) + "\n")
+    np.save(tmp_path / "t5_embeds.npy",
+            np.random.randn(4, 6, 24).astype(np.float32))
+    np.save(tmp_path / "t5_mask.npy", np.ones((4, 6), np.int32))
+
+    ds = ImagenDataset(str(tsv), embeds_prefix=str(tmp_path / "t5"),
+                       image_size=16, lowres_size=8)
+    assert len(ds) == 4
+    s = ds[2]
+    assert s["images"].shape == (16, 16, 3)
+    assert s["lowres_images"].shape == (8, 8, 3)
+    assert s["text_embeds"].shape == (6, 24)
+    assert -1.0 <= s["images"].min() and s["images"].max() <= 1.0
