@@ -5,13 +5,21 @@ GPT-345M, fp16 O2, seq_len 1024, local_bs 8 → ~16,200 tokens/s on 1x V100-32G
 (``/root/reference/docs/quick_start.md:112-116``). ``vs_baseline`` is the
 ratio of our measured tokens/s to that bar.
 
-Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N}
+Always prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N, ...}
+
+Environment-hardened: TPU backend init has been observed flaky (rc=1
+``Unable to initialize backend 'axon'`` in round 2), and a failed init is
+cached for the life of the process — so the parent retries the measurement
+in FRESH subprocesses with backoff, then falls back to the cpu backend, and
+on total failure still emits the JSON line with an ``error`` field.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -20,45 +28,63 @@ import numpy as np
 BASELINE_TOKENS_PER_S = 16200.0
 BATCH = 8
 SEQ = 1024
+HIDDEN, LAYERS, VOCAB = 1024, 24, 50304
+
 
 
 def _check_flash_numerics():
     """Compiled Pallas flash attention vs naive attention, on this backend."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from fleetx_tpu.ops import flash_attention as fa
+
+        rng = np.random.RandomState(0)
+        shape = (2, 512, 8, 64)
+        q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+        if not fa.supported(q, k):
+            return "flash-unsupported"
+        out = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))(q, k, v)
+        ref = jax.jit(lambda q, k, v: fa.reference_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True))(q, k, v)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+        status = "ok" if err < 2e-2 else "NUMERICS-DRIFT"
+        return f"flash-{status}(err={err:.1e})"
+    except Exception as e:  # report, never abort the throughput number
+        return f"flash-error({type(e).__name__}: {e})"
+
+
+def _bench_impl() -> dict:
+    """The actual measurement; assumes the backend initializes."""
     import jax
-    import jax.numpy as jnp
-    from fleetx_tpu.ops import flash_attention as fa
 
-    rng = np.random.RandomState(0)
-    shape = (2, 512, 8, 64)
-    q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
-    k = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
-    v = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
-    if not fa.supported(q, k):
-        return "flash-unsupported"
-    out = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))(q, k, v)
-    ref = jax.jit(lambda q, k, v: fa.reference_attention(
-        q.astype(jnp.float32), k.astype(jnp.float32),
-        v.astype(jnp.float32), causal=True))(q, k, v)
-    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
-    assert err < 2e-2, f"flash attention numerics off on-chip: max err {err}"
-    return f"flash-ok(err={err:.1e})"
-
-
-def main():
-    import jax
-
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
     flash_status = _check_flash_numerics()
+    # cpu fallback: the full 345M bs8xseq1024 step takes minutes on host —
+    # scale down so the round still records a finished measurement
+    scaled = platform == "cpu"
+    layers = 4 if scaled else LAYERS
+    bsz, seq = (2, 512) if scaled else (BATCH, SEQ)
+    # cpu fallback steps are ~100x slower — fewer of them still beat no data
+    warmup, n_steps = (1, 2) if scaled else (3, 10)
 
     from fleetx_tpu.core.engine import EagerEngine
     from fleetx_tpu.core.module import GPTModule
     from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
     from fleetx_tpu.optims.optimizer import build_optimizer
 
+    # recompute=full: the 16G-HBM v5e cannot hold bs8xseq1024 activations
+    # (the 32G V100 baseline config relies on fp16 O2 + more memory); remat
+    # is the reference's own recipe for this (pretrain_gpt_1.3B_dp8.yaml)
     cfg = {
-        "Model": dict(vocab_size=50304, hidden_size=1024, num_layers=24,
+        "Model": dict(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=layers,
                       num_attention_heads=16, ffn_hidden_size=4096,
-                      max_position_embeddings=SEQ),
+                      max_position_embeddings=seq, use_recompute=True,
+                      recompute_granularity="full"),
         "Engine": {"max_steps": 10_000, "logging_freq": 100},
         "Global": {"seed": 0},
     }
@@ -69,41 +95,127 @@ def main():
     engine = EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr)
 
     rng = np.random.RandomState(0)
-    tokens = rng.randint(0, 50304, size=(BATCH, SEQ + 1)).astype(np.int32)
+    tokens = rng.randint(0, VOCAB, size=(bsz, seq + 1)).astype(np.int32)
     batch = {
         "tokens": tokens[:, :-1],
         "position_ids": np.broadcast_to(
-            np.arange(SEQ, dtype=np.int32), (BATCH, SEQ)).copy(),
+            np.arange(seq, dtype=np.int32), (bsz, seq)).copy(),
         "labels": tokens[:, 1:],
-        "loss_mask": np.ones((BATCH, SEQ), np.float32),
+        "loss_mask": np.ones((bsz, seq), np.float32),
     }
 
     engine.prepare(batch)
+    from fleetx_tpu.core.engine.eager_engine import _param_count
+    n_params = _param_count(engine.state.params)
     sharded = engine.shard_batch(batch)
     with engine._ctx():
-        # warmup (compile + first steps)
-        for _ in range(3):
+        for _ in range(warmup):
             engine.state, metrics = engine._train_step(engine.state, sharded)
         jax.block_until_ready(metrics["loss"])
 
-        n_steps = 10
         t0 = time.perf_counter()
         for _ in range(n_steps):
             engine.state, metrics = engine._train_step(engine.state, sharded)
         loss = float(jax.block_until_ready(metrics["loss"]))
         dt = (time.perf_counter() - t0) / n_steps
 
-    tokens_per_s = BATCH * SEQ / dt
+    tokens_per_s = bsz * seq / dt
+    name = "gpt345m" if not scaled else f"gpt{layers}l_scaled"
     result = {
-        "metric": f"gpt345m_train_tokens_per_s_{platform}",
+        "metric": f"{name}_train_tokens_per_s_{platform}",
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(tokens_per_s / BASELINE_TOKENS_PER_S, 3),
+        # the baseline bar is the full 345M recipe — a scaled cpu run is
+        # recorded but not comparable
+        "vs_baseline": (round(tokens_per_s / BASELINE_TOKENS_PER_S, 3)
+                        if not scaled else 0.0),
         "step_time_s": round(dt, 4),
         "loss": round(loss, 3),
         "flash": flash_status,
+        "device_kind": getattr(dev, "device_kind", platform),
     }
-    print(json.dumps(result))
+    from fleetx_tpu.utils.hardware import gpt_flops_per_token, peak_flops
+
+    peak = peak_flops(dev)
+    if peak:
+        # the default mesh data-parallelizes over every local device — MFU is
+        # per-chip, so divide by the device count
+        flops = gpt_flops_per_token(layers, HIDDEN, seq,
+                                    num_params=n_params) * bsz * seq
+        result["mfu"] = round(flops / dt / (peak * jax.device_count()), 4)
+    return result
+
+
+def _run_child(extra_env: dict, timeout: float = 1200.0,
+               scrub_plugin: bool = False):
+    """One measurement attempt in a fresh subprocess; returns dict or error.
+
+    ``scrub_plugin`` removes TPU-plugin site dirs from PYTHONPATH — needed
+    for the cpu fallback because the plugin hijacks backend init (and can
+    block for many minutes) even under ``JAX_PLATFORMS=cpu``.
+    """
+    env = dict(os.environ)
+    env["FLEETX_BENCH_CHILD"] = "1"
+    env.update(extra_env)
+    if scrub_plugin:
+        from fleetx_tpu.utils.hardware import clean_cpu_env
+
+        base = clean_cpu_env(os.path.dirname(os.path.abspath(__file__)))
+        base.update(extra_env)
+        base["FLEETX_BENCH_CHILD"] = "1"
+        env = base
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=timeout,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    err_lines = proc.stderr.strip().splitlines()
+    # surface the most informative line: last one mentioning an error
+    for line in reversed(err_lines):
+        if any(k in line for k in ("Error", "ERROR", "error:", "FAILED")):
+            return None, line.strip()[-500:]
+    return None, (err_lines or ["no output"])[-1][-500:]
+
+
+def main():
+    if os.environ.get("FLEETX_BENCH_CHILD"):
+        print(json.dumps(_bench_impl()))
+        return 0
+
+    errors = []
+    # attempts 1-3: whatever backend the driver configured (the real chip).
+    # Backend init has been observed to BLOCK for 25+ min when the TPU
+    # tunnel is down — cap each attempt so the cpu fallback still runs.
+    for attempt, backoff in enumerate((0, 15)):
+        if backoff:
+            time.sleep(backoff)
+        result, err = _run_child({}, timeout=900.0)
+        if result is not None:
+            result["attempt"] = attempt + 1
+            print(json.dumps(result))
+            return 0
+        errors.append(err)
+    # fallback: cpu backend so the round still records a real measurement
+    result, err = _run_child({"JAX_PLATFORMS": "cpu"}, timeout=1500.0,
+                             scrub_plugin=True)
+    if result is not None:
+        result["note"] = "accelerator init failed; cpu fallback"
+        result["accelerator_errors"] = errors
+        print(json.dumps(result))
+        return 0
+    errors.append(err)
+    print(json.dumps({
+        "metric": "gpt345m_train_tokens_per_s", "value": 0.0,
+        "unit": "tokens/s", "vs_baseline": 0.0,
+        "error": "; ".join(str(e) for e in errors)[-800:],
+    }))
+    return 0
 
 
 if __name__ == "__main__":
