@@ -1,0 +1,58 @@
+"""Cached artifact fetching (reference ``ppfleetx/utils/download.py:43-117``).
+
+``cached_path`` resolves a local path, ``file://`` URL, or http(s) URL to a
+file under the cache dir (``FLEETX_CACHE`` env or ``~/.cache/fleetx_tpu``),
+downloading at most once. Downloads stream to a temp file and rename
+atomically, so concurrent processes never see partial artifacts. In
+air-gapped environments http(s) fetches fail loudly with the cache path to
+pre-populate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.parse
+import urllib.request
+
+from fleetx_tpu.utils.log import logger
+
+DEFAULT_CACHE = os.path.join(os.path.expanduser("~"), ".cache", "fleetx_tpu")
+
+
+def cache_dir() -> str:
+    return os.environ.get("FLEETX_CACHE", DEFAULT_CACHE)
+
+
+def cached_path(url_or_path: str, sub_dir: str = "") -> str:
+    """→ local file path; downloads http(s) URLs into the cache once."""
+    parsed = urllib.parse.urlparse(url_or_path)
+    if parsed.scheme in ("", "file"):
+        path = parsed.path if parsed.scheme == "file" else url_or_path
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        return path
+
+    name = os.path.basename(parsed.path) or "download"
+    key = hashlib.md5(url_or_path.encode()).hexdigest()[:8]
+    target_dir = os.path.join(cache_dir(), sub_dir)
+    os.makedirs(target_dir, exist_ok=True)
+    target = os.path.join(target_dir, f"{key}_{name}")
+    if os.path.exists(target):
+        return target
+
+    tmp = target + f".tmp.{os.getpid()}"
+    logger.info("downloading %s -> %s", url_or_path, target)
+    try:
+        with urllib.request.urlopen(url_or_path, timeout=60) as resp, \
+                open(tmp, "wb") as out:
+            shutil.copyfileobj(resp, out)
+        os.replace(tmp, target)
+    except Exception as e:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise RuntimeError(
+            f"could not download {url_or_path} ({e}); in air-gapped "
+            f"environments place the file at {target} manually") from e
+    return target

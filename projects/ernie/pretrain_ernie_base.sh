@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# ERNIE base pretraining (reference projects/ernie/pretrain_ernie_345M.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/nlp/ernie/pretrain_ernie_base.yaml "$@"

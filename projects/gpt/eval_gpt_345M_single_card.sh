@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# WikiText PPL / LAMBADA offline eval (reference run_eval.sh recipes).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/eval.py \
+    -c fleetx_tpu/configs/nlp/gpt/eval_gpt_345M_single_card.yaml "$@"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Export the AOT inference artifact (reference export_gpt_345M_single_card.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/export.py \
+    -c fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_single_card.yaml "$@"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Text generation from a checkpoint (reference projects/gpt/ generation recipe).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tasks/gpt/generation.py \
+    -c fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_single_card.yaml "$@"

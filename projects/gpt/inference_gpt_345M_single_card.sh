@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Run the exported model (reference inference recipe, tools/inference.py).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tasks/gpt/inference.py \
+    -c fleetx_tpu/configs/nlp/gpt/inference_gpt_345M_single_card.yaml "$@"

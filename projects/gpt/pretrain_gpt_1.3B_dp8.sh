@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# GPT-1.3B data-parallel over 8 chips (reference pretrain_gpt_1.3B_dp8.sh).
+# On a TPU pod slice, launch this same command on every host
+# (jax.distributed.initialize picks up the slice topology).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/nlp/gpt/pretrain_gpt_1.3B_dp8.yaml "$@"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# GPT-175B tensor×pipeline hybrid over 128 chips (reference
+# pretrain_gpt_175B_mp8_pp16.sh). Launch on every host of the pod slice.
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/nlp/gpt/pretrain_gpt_175B_mp8_pp16.yaml "$@"

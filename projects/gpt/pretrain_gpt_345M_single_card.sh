@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# GPT-345M single-chip pretraining (reference projects/gpt/
+# pretrain_gpt_345M_single_card.sh — paddle.distributed.launch becomes a
+# plain python invocation: jax discovers local chips itself).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml "$@"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# GPT-6.7B ZeRO-sharded over 16 chips (reference pretrain_gpt_6.7B_sharding16.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/nlp/gpt/pretrain_gpt_6.7B_sharding16.yaml "$@"

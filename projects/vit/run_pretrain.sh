@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# ViT-B/16 classification pretraining (reference projects/vit/run_pretrain.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/train.py \
+    -c fleetx_tpu/configs/vis/vit/ViT_base_patch16_224_pretrain.yaml "$@"
