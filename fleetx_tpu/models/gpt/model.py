@@ -63,6 +63,7 @@ class GPTConfig:
     qat_bits: int = 8
     pp_degree: int = 1         # pipeline stages (reference pp_degree)
     pp_microbatches: int = 0   # 0 → defaults to pp_degree (ref accumulate_steps)
+    virtual_pp_degree: int = 1  # interleaved chunks/device (ref virtual pp)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -218,7 +219,10 @@ class MultiHeadAttention(nn.Module):
                         self.make_rng("dropout"), (1,), 0,
                         jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
                     kwargs.update(dropout_rate=rate, dropout_seed=seed)
-                fn = partial(flash_attention.flash_attention, **kwargs)
+                # mesh-aware: run the kernel per-device (GSPMD cannot
+                # partition the Mosaic custom call); falls back to the plain
+                # call off-mesh
+                fn = partial(flash_attention.flash_attention_sharded, **kwargs)
         if cfg.use_recompute and cfg.recompute_granularity == "core_attn":
             fn = jax.checkpoint(fn)
         return fn(q, k, v)
@@ -410,14 +414,16 @@ class GPTModel(nn.Module):
                 make_stage_stack, pipeline_apply)
 
             assert attention_mask is None, "pipeline mode is training-only"
-            assert cfg.num_layers % cfg.pp_degree == 0
+            V = max(cfg.virtual_pp_degree, 1)
+            chunks = cfg.pp_degree * V
+            assert cfg.num_layers % chunks == 0
             pcfg = dataclasses.replace(cfg, use_flash_attention=False)
             stages = make_stage_stack(
-                layer, cfg.pp_degree,
-                cfg.num_layers // cfg.pp_degree)(pcfg, name="layers")
+                layer, cfg.pp_degree, cfg.num_layers // chunks,
+                num_repeats=V)(pcfg, name="layers")
             x = pipeline_apply(stages, x, cfg.pp_degree,
                                cfg.pp_microbatches or cfg.pp_degree,
-                               deterministic=deterministic)
+                               deterministic=deterministic, num_repeats=V)
             new_cache = None
         elif cfg.scan_layers:
             layer_caches = None

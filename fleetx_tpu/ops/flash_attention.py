@@ -422,3 +422,65 @@ def reference_attention(q, k, v, *, causal: bool = True,
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bnqk,bknd->bqnd", p.astype(q.dtype), v)
+
+
+def sharded_supported(q: jax.Array, mesh) -> bool:
+    """True when the per-device shards still satisfy the kernel contract:
+    batch divides the data axes, heads divide the tensor axis, and the seq
+    axis is not context-sharded (ring attention owns that case)."""
+    if mesh is None or q.ndim != 4:
+        return False
+    shape = dict(mesh.shape)
+    dp = shape.get("data", 1) * shape.get("fsdp", 1)
+    tp = shape.get("tensor", 1)
+    if shape.get("seq", 1) != 1:
+        return False
+    b, _, n, _ = q.shape
+    return b % dp == 0 and n % tp == 0
+
+
+def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                            mesh=None, causal: bool = True,
+                            **kwargs) -> jax.Array:
+    """Mesh-aware flash attention: the kernel is a Mosaic custom call GSPMD
+    cannot partition, so under a multi-device mesh the operands would be
+    all-gathered and the kernel run replicated. This wrapper runs it
+    per-device instead — batch sharded over ``(data, fsdp)``, heads over
+    ``tensor`` — via a partial-manual ``shard_map`` (attention is
+    embarrassingly parallel over both dims; remaining axes stay automatic).
+
+    The in-kernel dropout seed is folded with the device's linear index so
+    shards draw independent masks.
+    """
+    from functools import partial as _partial
+
+    from jax.sharding import PartitionSpec as _P
+
+    if mesh is None:
+        from fleetx_tpu.parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+    if mesh is None or not sharded_supported(q, mesh):
+        return flash_attention(q, k, v, causal=causal, **kwargs)
+
+    manual = tuple(a for a in ("data", "fsdp", "tensor")
+                   if mesh.shape.get(a, 1) > 1)
+    if not manual:
+        return flash_attention(q, k, v, causal=causal, **kwargs)
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in manual)
+    head_axis = "tensor" if "tensor" in manual else None
+    spec = _P(batch_axes or None, None, head_axis, None)
+
+    def body(q, k, v):
+        kw = dict(kwargs)
+        if kw.get("dropout_seed") is not None:
+            ix = jnp.int32(0)
+            for a in manual:
+                ix = ix * mesh.shape[a] + jax.lax.axis_index(a)
+            kw["dropout_seed"] = kw["dropout_seed"] + ix
+        return flash_attention(q, k, v, causal=causal, **kw)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names=frozenset(manual),
+                       check_vma=False)
+    return fn(q, k, v)
