@@ -147,6 +147,9 @@ class GPTModule(LanguageModule):
             pp = int(dist.get("pp_degree") or 1)
             if pp > 1 and not model_cfg.get("pp_degree"):
                 model_cfg["pp_degree"] = pp
+            vpp = int(dist.get("virtual_pp_degree") or 0)
+            if vpp > 1 and not model_cfg.get("virtual_pp_degree"):
+                model_cfg["virtual_pp_degree"] = vpp
             if int(model_cfg.get("pp_degree") or 1) > 1 and \
                     not model_cfg.get("pp_microbatches"):
                 model_cfg["pp_microbatches"] = int(eng.get("accumulate_steps") or 0)

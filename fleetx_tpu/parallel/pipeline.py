@@ -40,14 +40,20 @@ __all__ = ["make_stage_stack", "pipeline_apply"]
 
 
 def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
-                     layers_per_stage: int) -> Type[nn.Module]:
-    """Stage-stacked layer module: params ``[num_stages, layers_per_stage, ...]``.
+                     layers_per_stage: int,
+                     num_repeats: int = 1) -> Type[nn.Module]:
+    """Stage-stacked layer module: params ``[num_stages, layers_per_stage, ...]``
+    (or ``[num_repeats, num_stages, layers_per_stage, ...]`` for interleaved
+    virtual stages).
 
-    The inner ``nn.scan`` runs one stage's layers sequentially (axis name
-    ``layers``, same as the non-pipelined stack); the outer ``nn.vmap`` adds
-    the stage axis (name ``pipe_stage``, sharded over ``pipe`` by the rule
-    table). Tree paths are identical to the non-pipelined stack — only the
-    leading dims differ (``[L] → [S, L/S]``).
+    The inner ``nn.scan`` runs one chunk's layers sequentially (axis name
+    ``layers``, same as the non-pipelined stack); ``nn.vmap`` adds the stage
+    axis (name ``pipe_stage``, sharded over ``pipe`` by the rule table) and,
+    for virtual pipelining, an outer unsharded repeat axis (``pipe_repeat``):
+    logical stage ``l = v*S + d`` lives as chunk ``[v, d]`` — the reference's
+    ``virtual_pp_degree`` round-robin placement (``hybrid_model.py:962``).
+    Tree paths are identical to the non-pipelined stack — only the leading
+    dims differ (``[L] → [V, S, L/(V*S)]``).
     """
     stage = nn.scan(
         layer_cls,
@@ -58,13 +64,23 @@ def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
         length=layers_per_stage,
         metadata_params={nn.PARTITION_NAME: "layers"},
     )
-    return nn.vmap(
+    stages = nn.vmap(
         stage,
         variable_axes={"params": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=(0, None, None, None),
         out_axes=0,
         metadata_params={nn.PARTITION_NAME: "pipe_stage"},
+    )
+    if num_repeats == 1:
+        return stages
+    return nn.vmap(
+        stages,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=(0, None, None, None),
+        out_axes=0,
+        metadata_params={nn.PARTITION_NAME: "pipe_repeat"},
     )
 
 
@@ -73,14 +89,22 @@ def _constrain(x: jnp.ndarray, axes: tuple) -> jnp.ndarray:
 
 
 def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
-                   num_microbatches: int, deterministic: bool = True) -> jnp.ndarray:
+                   num_microbatches: int, deterministic: bool = True,
+                   num_repeats: int = 1) -> jnp.ndarray:
     """Run a batch through the stage stack on the GPipe microbatch schedule.
 
     Must be called from the parent module's compact scope. ``x`` is the
     embedded batch ``[B, seq, hidden]``; it is split into
     ``num_microbatches`` microbatches that flow through the stages.
+
+    ``num_repeats`` > 1 is the interleaved/virtual schedule: ``S*V`` logical
+    stages laid round-robin over ``S`` devices, so each hand-off moves only
+    ``L/(S*V)`` layers' worth of work and the pipeline bubble shrinks by
+    ``V`` (the reference's ``virtual_pp_degree``). The hand-off ``l → l+1``
+    decomposes into a ppermute along the device dim plus a local roll along
+    the repeat dim.
     """
-    S, M = num_stages, num_microbatches
+    S, M, V = num_stages, num_microbatches, num_repeats
     batch = x.shape[0]
     if batch % M:
         # only param-init traces (single sample) may bypass microbatching;
@@ -92,47 +116,72 @@ def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
     mb = batch // M
     rest = x.shape[1:]
     act_axes = ("batch", "act_seq", "act_embed")
+    n_logical = S * V
 
     micro = x.reshape((M, mb) + rest)
-    # bubble padding: the last S-1 iterations drain the pipe with zero inputs
+    # bubble padding: the last S*V-1 iterations drain the pipe
     stream = jnp.concatenate(
-        [micro, jnp.zeros((S - 1, mb) + rest, x.dtype)], axis=0)
+        [micro, jnp.zeros((n_logical - 1, mb) + rest, x.dtype)], axis=0)
     stream = _constrain(stream, (None,) + act_axes)
+    shift_axes = (("act_stage",) if V == 1 else (None, "act_stage")) + act_axes
 
     def iteration(mod, shift, x_in):
-        # stage 0 ingests the next microbatch; stages 1..S-1 keep what the
-        # previous iteration's roll handed them
-        shift = shift.at[0].set(x_in)
-        shift = _constrain(shift, ("act_stage",) + act_axes)
+        # logical stage 0 ingests the next microbatch; the rest keep what
+        # the previous iteration's roll handed them
+        if V == 1:
+            shift = shift.at[0].set(x_in)
+        else:
+            shift = shift.at[0, 0].set(x_in)
+        shift = _constrain(shift, shift_axes)
         out, _ = mod(shift, None, deterministic, None)
-        out = _constrain(out, ("act_stage",) + act_axes)
-        y_last = out[-1]                    # drain from the final stage
-        new_shift = jnp.roll(out, 1, axis=0)  # ICI collective-permute
+        out = _constrain(out, shift_axes)
+        if V == 1:
+            y_last = out[-1]                      # drain final logical stage
+            new_shift = jnp.roll(out, 1, axis=0)  # ICI collective-permute
+        else:
+            y_last = out[-1, -1]
+            # hand-off l=v*S+d -> l+1: ppermute along the (sharded) stage
+            # dim; the wrap d=S-1 -> d=0 must also advance the repeat, which
+            # is a local roll of column 0 along the (unsharded) repeat dim
+            rolled = jnp.roll(out, 1, axis=1)
+            col0 = jnp.roll(rolled[:, 0], 1, axis=0)
+            new_shift = rolled.at[:, 0].set(col0)
+        new_shift = _constrain(new_shift, shift_axes)
         return new_shift, y_last
 
     run = nn.scan(
         iteration,
         variable_broadcast="params",
         split_rngs={"params": False, "dropout": True},
-        length=M + S - 1,
+        length=M + n_logical - 1,
         in_axes=0,
         out_axes=0,
     )
-    shift0 = jnp.zeros((S, mb) + rest, x.dtype)
+    shape0 = ((S,) if V == 1 else (V, S)) + (mb,) + rest
+    shift0 = jnp.zeros(shape0, x.dtype)
     _, ys = run(stages, shift0, stream)
-    # iteration t drains microbatch t-(S-1); drop the S-1 ramp-up bubbles
-    out = ys[S - 1:]
+    # iteration t drains microbatch t-(S*V-1); drop the ramp-up bubbles
+    out = ys[n_logical - 1:]
     return _constrain(out.reshape((batch,) + rest), act_axes)
 
 
-def split_stage_params(stack_params: Any, num_stages: int) -> Any:
+def split_stage_params(stack_params: Any, num_stages: int,
+                       num_repeats: int = 1) -> Any:
     """Reshape a non-pipelined layer stack's params ``[L, ...]`` into the
-    pipelined layout ``[S, L/S, ...]`` (tree paths are identical)."""
+    pipelined layout ``[S, L/S, ...]`` — or ``[V, S, L/(V*S), ...]`` for
+    virtual stages, where logical chunk ``v*S + d`` lands at ``[v, d]``
+    (tree paths are identical)."""
     import jax
+
+    chunks = num_stages * num_repeats
 
     def reshape(leaf):
         L = leaf.shape[0]
-        assert L % num_stages == 0
-        return leaf.reshape((num_stages, L // num_stages) + leaf.shape[1:])
+        assert L % chunks == 0
+        shape = (chunks, L // chunks) + leaf.shape[1:]
+        out = leaf.reshape(shape)
+        if num_repeats > 1:
+            out = out.reshape((num_repeats, num_stages) + shape[1:])
+        return out
 
     return jax.tree.map(reshape, stack_params)

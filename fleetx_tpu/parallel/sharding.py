@@ -52,6 +52,7 @@ def make_axis_rules(dist_config: dict | None = None) -> tuple[tuple[str, Any], .
         ("kv", None),
         ("layers", None),
         ("pipe_stage", "pipe"),
+        ("pipe_repeat", None),
         ("act_stage", "pipe"),
         ("norm", None),
         ("embed", "fsdp" if stage >= 3 else None),

@@ -95,6 +95,54 @@ def test_pipelined_logits_and_grads_match_plain_stack(devices8):
         _stage_params(g1, 2), g2)
 
 
+def test_virtual_pipeline_logits_match_plain_stack(devices8):
+    """Interleaved schedule (pp2 x vpp2 = 4 logical stages on 2 devices)."""
+    b = batch()
+    cfg1 = GPTConfig(**BASE)
+    model1 = GPTForPretraining(cfg1)
+    params1 = meta.unbox(model1.init(
+        {"params": jax.random.PRNGKey(0)}, b["tokens"], b["position_ids"],
+        deterministic=True)["params"])
+    logits1 = model1.apply({"params": params1}, b["tokens"], b["position_ids"],
+                           deterministic=True)
+
+    cfg2 = GPTConfig(**BASE, pp_degree=2, virtual_pp_degree=2,
+                     pp_microbatches=4)
+    model2 = GPTForPretraining(cfg2)
+    params2 = dict(params1)
+    params2["gpt"] = dict(params1["gpt"])
+    params2["gpt"]["layers"] = split_stage_params(
+        params1["gpt"]["layers"], 2, num_repeats=2)
+
+    mesh = build_mesh({"pp_degree": 2}, devices=devices8)
+    with mesh, nn.logical_axis_rules(make_axis_rules({"pp_degree": 2})):
+        logits2 = jax.jit(lambda p: model2.apply(
+            {"params": p}, b["tokens"], b["position_ids"],
+            deterministic=True))(params2)
+
+        def loss2(p):
+            lg = model2.apply({"params": p}, b["tokens"], b["position_ids"],
+                              deterministic=True)
+            return cross_entropy_loss(lg, b["labels"], b["loss_mask"])
+
+        g2 = jax.jit(jax.grad(loss2))(params2)
+
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(logits1),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss1(p):
+        lg = model1.apply({"params": p}, b["tokens"], b["position_ids"],
+                          deterministic=True)
+        return cross_entropy_loss(lg, b["labels"], b["loss_mask"])
+
+    g1 = jax.grad(loss1)(params1)
+    jax.tree.map(
+        lambda a, c: np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                                rtol=1e-4, atol=1e-5),
+        split_stage_params(g1["gpt"]["layers"], 2, num_repeats=2),
+        g2["gpt"]["layers"])
+
+
 def _make_engine(cfg, mesh):
     module = GPTModule(cfg)
     lr = build_lr_scheduler({"name": "cosine", "max_lr": 1e-3, "min_lr": 1e-4,
