@@ -1,2 +1,3 @@
+from fleetx_tpu.core.engine.auto_engine import AutoEngine  # noqa: F401
 from fleetx_tpu.core.engine.eager_engine import (  # noqa: F401
     EagerEngine, TrainState, ScalerState, batch_sharding)

@@ -1,0 +1,20 @@
+"""AutoEngine — API-parity alias for the reference's auto-parallel stack.
+
+Reference: ``ppfleetx/core/engine/auto_engine.py:36-133`` wraps
+``paddle.distributed.fleet.auto.Engine``, which compiles the dygraph model
+into a distributed static program (mesh planning, partitioning, collective
+insertion). In this framework that compilation model IS the default path:
+``EagerEngine`` jits one mesh-sharded train step and GSPMD performs the
+planning/partitioning the reference's auto stack hand-rolls (SURVEY.md §7
+design stance). ``AutoEngine`` therefore subclasses ``EagerEngine``
+unchanged — it exists so reference users find the name and so
+``tools/auto.py`` mirrors the reference CLI surface.
+"""
+
+from __future__ import annotations
+
+from fleetx_tpu.core.engine.eager_engine import EagerEngine
+
+
+class AutoEngine(EagerEngine):
+    """GSPMD-compiled engine (the reference auto stack, subsumed)."""

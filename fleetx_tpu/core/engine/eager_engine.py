@@ -103,6 +103,20 @@ class EagerEngine:
         self.mesh = mesh if mesh is not None else build_mesh(dist)
         self.rules = make_axis_rules(dist)
         self.sharding_stage = int((dist.get("sharding") or {}).get("sharding_stage") or 0)
+        self.sharding_offload = bool(
+            (dist.get("sharding") or {}).get("sharding_offload"))
+        if self.sharding_offload and jax.default_backend() != "tpu":
+            # host memory-kind placement needs the TPU runtime; the virtual
+            # CPU backend rejects replicated placement annotations
+            logger.warning("sharding_offload requires a TPU backend; "
+                           "continuing without offload")
+            self.sharding_offload = False
+        if self.sharding_offload and self.use_fp16_scaler:
+            # the scaler's overflow-revert would compute directly on
+            # host-resident state; keep the combinations orthogonal
+            logger.warning("sharding_offload is not supported with the fp16 "
+                           "scaler; continuing without offload")
+            self.sharding_offload = False
         self.pp_degree = int(dist.get("pp_degree") or 1)
         if self.pp_degree > 1:
             # the pipeline consumes the local batch as micro-batches itself
@@ -179,6 +193,16 @@ class EagerEngine:
                 opt_sh = _tree_of(shardings.opt_state)
                 shardings = shardings.replace(opt_state=zero_sharding(
                     opt_abs, self.mesh, param_shardings=opt_sh))
+            self._opt_dev_shardings = None
+            if self.sharding_offload and self.sharding_stage >= 1:
+                # ZeRO offload (reference group_sharded_parallel
+                # offload=True): optimizer state LIVES in host memory and is
+                # streamed to device memory around the update inside the
+                # jitted step (XLA memory kinds over PCIe/DMA)
+                self._opt_dev_shardings = shardings.opt_state
+                shardings = shardings.replace(opt_state=jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"),
+                    shardings.opt_state))
             self.state_shardings = shardings
             init_fn = jax.jit(make_state, out_shardings=shardings)
             t0 = time.time()
@@ -199,6 +223,9 @@ class EagerEngine:
         accum = self.accumulate_steps
         base_rng = self._base_rng
         use_scaler = self.use_fp16_scaler
+        opt_dev_shardings = getattr(self, "_opt_dev_shardings", None)
+        opt_host_shardings = (self.state_shardings.opt_state
+                              if opt_dev_shardings is not None else None)
 
         def grads_and_metrics(params, scaler, batch, step):
             def loss_fn(p):
@@ -242,7 +269,12 @@ class EagerEngine:
             if lr_schedule is not None:
                 metrics["lr"] = lr_schedule(state.step)
 
-            updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            opt_state = state.opt_state
+            if opt_dev_shardings is not None:  # offload: host -> device
+                opt_state = jax.device_put(opt_state, opt_dev_shardings)
+            updates, new_opt = optimizer.update(grads, opt_state, state.params)
+            if opt_dev_shardings is not None:  # device -> host
+                new_opt = jax.device_put(new_opt, opt_host_shardings)
             new_params = optax.apply_updates(state.params, updates)
 
             new_scaler = state.scaler

@@ -1,0 +1,51 @@
+"""Collate helpers (reference collate.py Stack/Pad/Tuple/Dict) + download cache."""
+
+import numpy as np
+import pytest
+
+from fleetx_tpu.data.sampler.collate import Dict, Pad, Stack, Tuple
+from fleetx_tpu.utils.download import cached_path
+
+
+def test_stack():
+    out = Stack(dtype=np.float32)([[1, 2], [3, 4]])
+    assert out.dtype == np.float32 and out.shape == (2, 2)
+
+
+def test_pad_right_and_lengths():
+    batch, lens = Pad(pad_val=-1, ret_length=True)([[1, 2, 3], [4]])
+    np.testing.assert_array_equal(batch, [[1, 2, 3], [4, -1, -1]])
+    np.testing.assert_array_equal(lens, [3, 1])
+
+
+def test_pad_left():
+    batch = Pad(pad_val=0, pad_right=False)([[1, 2], [7, 8, 9]])
+    np.testing.assert_array_equal(batch, [[0, 1, 2], [7, 8, 9]])
+
+
+def test_tuple_routing_flattens_lengths():
+    collate = Tuple(Stack(), Pad(pad_val=0, ret_length=True))
+    samples = [([1, 2], [5]), ([3, 4], [6, 7])]
+    stacked, padded, lens = collate(samples)
+    np.testing.assert_array_equal(stacked, [[1, 2], [3, 4]])
+    np.testing.assert_array_equal(padded, [[5, 0], [6, 7]])
+    np.testing.assert_array_equal(lens, [1, 2])
+
+
+def test_dict_routing():
+    collate = Dict({"tokens": Pad(pad_val=0, ret_length=True),
+                    "label": Stack()})
+    out = collate([{"tokens": [1, 2], "label": 0},
+                   {"tokens": [3], "label": 1}])
+    np.testing.assert_array_equal(out["tokens"], [[1, 2], [3, 0]])
+    np.testing.assert_array_equal(out["tokens_length"], [2, 1])
+    np.testing.assert_array_equal(out["label"], [0, 1])
+
+
+def test_cached_path_local_and_missing(tmp_path):
+    f = tmp_path / "x.txt"
+    f.write_text("hi")
+    assert cached_path(str(f)) == str(f)
+    assert cached_path(f"file://{f}") == str(f)
+    with pytest.raises(FileNotFoundError):
+        cached_path(str(tmp_path / "missing.txt"))

@@ -1,0 +1,17 @@
+"""Auto-parallel training entry point (reference ``tools/auto.py:270-296``).
+
+In the reference this drives a separate static-graph compilation stack; here
+GSPMD compilation is the only stack, so this is the same flow as
+``tools/train.py`` through ``AutoEngine`` (see
+``fleetx_tpu/core/engine/auto_engine.py`` for why the stacks merged).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if __name__ == "__main__":
+    import train
+
+    train.main()
