@@ -77,14 +77,16 @@ def _bench_impl() -> dict:
     from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
     from fleetx_tpu.optims.optimizer import build_optimizer
 
-    # recompute=full: the 16G-HBM v5e cannot hold bs8xseq1024 activations
+    # recompute: the 16G-HBM v5e cannot hold bs8xseq1024 activations
     # (the 32G V100 baseline config relies on fp16 O2 + more memory); remat
-    # is the reference's own recipe for this (pretrain_gpt_1.3B_dp8.yaml)
+    # is the reference's own recipe for this (pretrain_gpt_1.3B_dp8.yaml).
+    # The parent tries "dots" (fastest that might fit) before "full".
+    granularity = os.environ.get("FLEETX_BENCH_RECOMPUTE", "full")
     cfg = {
         "Model": dict(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=layers,
                       num_attention_heads=16, ffn_hidden_size=4096,
                       max_position_embeddings=seq, use_recompute=True,
-                      recompute_granularity="full"),
+                      recompute_granularity=granularity),
         "Engine": {"max_steps": 10_000, "logging_freq": 100},
         "Global": {"seed": 0},
     }
@@ -189,18 +191,21 @@ def main():
         return 0
 
     errors = []
-    # attempts 1-3: whatever backend the driver configured (the real chip).
-    # Backend init has been observed to BLOCK for 25+ min when the TPU
-    # tunnel is down — cap each attempt so the cpu fallback still runs.
-    for attempt, backoff in enumerate((0, 15)):
+    # accelerator attempts: fastest recompute policy first ("dots" keeps
+    # matmul outputs; may OOM on 16G — "full" remat always fits). Backend
+    # init has been observed to BLOCK for 25+ min when the TPU tunnel is
+    # down — cap each attempt so the cpu fallback still runs.
+    for attempt, (backoff, gran) in enumerate(((0, "dots"), (15, "full"))):
         if backoff:
             time.sleep(backoff)
-        result, err = _run_child({}, timeout=900.0)
+        result, err = _run_child({"FLEETX_BENCH_RECOMPUTE": gran},
+                                 timeout=900.0)
         if result is not None:
             result["attempt"] = attempt + 1
+            result["recompute"] = gran
             print(json.dumps(result))
             return 0
-        errors.append(err)
+        errors.append(f"[{gran}] {err}")
     # fallback: cpu backend so the round still records a real measurement
     result, err = _run_child({"JAX_PLATFORMS": "cpu"}, timeout=1500.0,
                              scrub_plugin=True)

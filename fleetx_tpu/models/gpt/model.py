@@ -53,7 +53,10 @@ class GPTConfig:
     initializer_range: float = 0.02
     layer_norm_epsilon: float = 1e-5
     use_recompute: bool = False
-    recompute_granularity: str = "full"  # full | full_attn | core_attn
+    # full | full_attn | core_attn (reference granularities) | dots
+    # ("dots" keeps matmul outputs and recomputes elementwise — the
+    # TPU-native middle ground between memory and recompute FLOPs)
+    recompute_granularity: str = "full"
     scan_layers: bool = True
     use_flash_attention: bool = True
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
@@ -397,12 +400,15 @@ class GPTModel(nn.Module):
         x = GPTEmbeddings(cfg, name="embeddings")(tokens, position_ids, deterministic)
 
         layer = TransformerDecoderLayer
-        if cfg.use_recompute and cfg.recompute_granularity == "full" and cache is None:
+        if cfg.use_recompute and cache is None and \
+                cfg.recompute_granularity in ("full", "dots"):
+            policy = (jax.checkpoint_policies.nothing_saveable
+                      if cfg.recompute_granularity == "full" else
+                      jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
             # deterministic/attention_mask are control flags, not data — keep
             # them static under remat (with dropout>0 they'd otherwise be
             # traced and break `not deterministic`)
-            layer = nn.remat(layer, prevent_cse=False,
-                             policy=jax.checkpoint_policies.nothing_saveable,
+            layer = nn.remat(layer, prevent_cse=False, policy=policy,
                              static_argnums=(3, 4))
 
         if cfg.pp_degree > 1 and cache is None:
