@@ -68,7 +68,13 @@ class GPTTokenizer:
     # -- construction --------------------------------------------------------
     @classmethod
     def from_files(cls, vocab_file: str, merges_file: str) -> "GPTTokenizer":
-        """Load standard GPT-2 ``vocab.json`` + ``merges.txt``."""
+        """Load standard GPT-2 ``vocab.json`` + ``merges.txt`` (local paths
+        or URLs — URLs go through the download cache, reference
+        ``gpt_tokenizer.py:106-140`` + ``utils/download.py``)."""
+        from fleetx_tpu.utils.download import cached_path
+
+        vocab_file = cached_path(vocab_file, sub_dir="tokenizers")
+        merges_file = cached_path(merges_file, sub_dir="tokenizers")
         with open(vocab_file, encoding="utf-8") as f:
             vocab = json.load(f)
         merges = []
