@@ -1,3 +1,4 @@
 from fleetx_tpu.core.engine.auto_engine import AutoEngine  # noqa: F401
+from fleetx_tpu.core.engine.basic_engine import BasicEngine  # noqa: F401
 from fleetx_tpu.core.engine.eager_engine import (  # noqa: F401
     EagerEngine, TrainState, ScalerState, batch_sharding)

@@ -70,7 +70,10 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(("data", "fsdp")))
 
 
-class EagerEngine:
+from fleetx_tpu.core.engine.basic_engine import BasicEngine
+
+
+class EagerEngine(BasicEngine):
     """Mesh-sharded trainer with the reference's loop semantics."""
 
     def __init__(self, cfg: dict, module, optimizer=None, lr_schedule=None,

@@ -82,7 +82,20 @@ def build_mesh(dist_config: dict | None = None, devices: list | None = None) -> 
         dp = n // fixed
     shape = (pp, dp, fsdp, seq, mp)
     assert int(np.prod(shape)) == n, f"mesh shape {shape} != {n} devices"
-    if n == 1:
+
+    # multi-slice pods: data parallelism rides DCN between slices while
+    # tensor/pipe/fsdp collectives stay on each slice's ICI (the scaling-book
+    # recipe; the reference's closest analogue is multi-node NCCL dp)
+    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    n_slices = len(slice_ids)
+    if n_slices > 1:
+        dcn_dp = int(cfg.get("dcn_dp_degree") or n_slices)
+        assert dp % dcn_dp == 0, (dp, dcn_dp)
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            (pp, dp // dcn_dp, fsdp, seq, mp), (1, dcn_dp, 1, 1, 1),
+            devices=devices)
+        logger.info("hybrid mesh: %d slices, dcn_dp=%d", n_slices, dcn_dp)
+    elif n == 1:
         device_array = np.asarray(devices).reshape(shape)
     else:
         device_array = mesh_utils.create_device_mesh(shape, devices=devices)

@@ -1,0 +1,42 @@
+"""Parallel shell-command runner for data preparation
+(reference ``ppfleetx/tools/multiprocess_tool.py:49-87``).
+
+Runs a list of shell commands with bounded parallelism and reports
+failures — the reference uses it for sharded corpus download/convert jobs;
+same contract here.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from concurrent.futures import ThreadPoolExecutor, as_completed
+
+from fleetx_tpu.utils.log import logger
+
+
+def run_commands(commands: list[str], num_workers: int = 4,
+                 stop_on_error: bool = False) -> list[int]:
+    """Execute shell commands in parallel; returns per-command exit codes."""
+    results = [None] * len(commands)
+
+    def run(i: int) -> int:
+        proc = subprocess.run(commands[i], shell=True,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            logger.error("command failed (%d): %s\n%s", proc.returncode,
+                         commands[i], proc.stderr[-500:])
+        return proc.returncode
+
+    with ThreadPoolExecutor(max_workers=num_workers) as pool:
+        futures = {pool.submit(run, i): i for i in range(len(commands))}
+        for fut in as_completed(futures):
+            i = futures[fut]
+            results[i] = fut.result()
+            if stop_on_error and results[i] != 0:
+                for other in futures:
+                    other.cancel()
+                break
+    done = sum(1 for r in results if r == 0)
+    logger.info("ran %d commands: %d ok, %d failed", len(commands), done,
+                sum(1 for r in results if r not in (0, None)))
+    return [r if r is not None else -1 for r in results]

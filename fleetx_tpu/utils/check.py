@@ -1,0 +1,43 @@
+"""Startup environment checks (reference ``utils/check.py:250-277`` +
+``utils/version.py:18-21`` — paddle-version / GPU checks become jax-version /
+device checks)."""
+
+from __future__ import annotations
+
+from fleetx_tpu.utils.log import logger
+
+MIN_JAX = (0, 4, 35)
+
+
+def check_version() -> bool:
+    import jax
+
+    parts = tuple(int(p) for p in jax.__version__.split(".")[:3])
+    ok = parts >= MIN_JAX
+    if not ok:
+        logger.warning("jax %s < required %s", jax.__version__,
+                       ".".join(map(str, MIN_JAX)))
+    return ok
+
+
+def check_devices(expect_tpu: bool = False) -> bool:
+    """Log the device inventory; warn when a TPU config runs on CPU."""
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    logger.info("devices: %d x %s (%s)", len(devices), platform,
+                getattr(devices[0], "device_kind", "?"))
+    if expect_tpu and platform != "tpu":
+        logger.warning("config requests device: tpu but backend is %s — "
+                       "continuing (dev mode)", platform)
+        return False
+    return True
+
+
+def check_config(cfg: dict) -> bool:
+    """Run all startup checks for a parsed config."""
+    ok = check_version()
+    glb = dict(cfg.get("Global") or {})
+    ok &= check_devices(expect_tpu=str(glb.get("device", "")).lower() == "tpu")
+    return ok

@@ -33,6 +33,9 @@ def main():
     env_mod.init_dist_env()
     cfg = config_mod.get_config(args.config, args.override, show=True)
 
+    from fleetx_tpu.utils.check import check_config
+    check_config(cfg)
+
     mesh = set_mesh(build_mesh(cfg.get("Distributed")))
     module = build_module(cfg)
 
