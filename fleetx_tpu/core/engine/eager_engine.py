@@ -440,6 +440,29 @@ class EagerEngine(BasicEngine):
             })
         return total / max(count, 1)
 
+    # ------------------------------------------------------------- predict
+    def predict(self, data_loader: Iterable, max_batches: int = 0):
+        """Forward-only loop (reference predict, ``eager_engine.py:523-579``):
+        returns host arrays of ``module.predict_step`` per batch."""
+        assert self.state is not None, "call prepare()/fit() first"
+        if getattr(self, "_predict_step", None) is None:
+            with self._ctx():
+                self._predict_step = jax.jit(
+                    lambda state, batch: self.module.predict_step(
+                        state.params, batch),
+                    in_shardings=(self.state_shardings,
+                                  batch_sharding(self.mesh)),
+                    out_shardings=None)
+        outputs = []
+        with self._ctx():
+            for i, batch in enumerate(data_loader):
+                if max_batches and i >= max_batches:
+                    break
+                batch = self.module.pretreating_batch(batch)
+                out = self._predict_step(self.state, self.shard_batch(batch))
+                outputs.append(jax.device_get(out))
+        return outputs
+
     # ------------------------------------------------------------ inference
     def inference(self, data: list):
         """Delegate to the AOT ``InferenceEngine`` (reference

@@ -52,6 +52,10 @@ class BasicModule:
     def validation_loss(self, params: Any, batch: dict) -> tuple[jax.Array, dict]:
         raise NotImplementedError
 
+    def predict_step(self, params: Any, batch: dict) -> Any:
+        """Pure forward for ``engine.predict`` (reference ``test_step``)."""
+        raise NotImplementedError
+
     # -- host-side hooks (reference basic_module.py:239-283) -----------------
     def pretreating_batch(self, batch: dict) -> dict:
         return batch
@@ -200,6 +204,14 @@ class GPTModule(LanguageModule):
             deterministic=True)
         loss = cross_entropy_loss(logits, batch["labels"], batch["loss_mask"])
         return loss, {"loss": loss}
+
+    def predict_step(self, params, batch):
+        """Forward logits (reference ``test_step``/predict loop)."""
+        from flax.core import meta
+
+        return self.model.apply(
+            {"params": meta.unbox(params)}, batch["tokens"],
+            batch.get("position_ids"), deterministic=True)
 
     def input_spec(self):
         s = self.model_cfg.max_position_embeddings

@@ -147,6 +147,19 @@ def test_grad_accumulation_matches_big_batch(devices8):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_predict_returns_logits(devices8):
+    cfg = tiny_cfg()
+    cfg["Distributed"] = {"dp_degree": 4, "mp_degree": 2}
+    mesh = build_mesh(cfg["Distributed"], devices=devices8)
+    eng = build_engine(cfg, mesh)
+    b = make_batches(1)[0]
+    eng.prepare(b)
+    outs = eng.predict([b, b], max_batches=2)
+    assert len(outs) == 2
+    assert outs[0].shape == (BATCH, SEQ, VOCAB)
+    assert np.isfinite(outs[0]).all()
+
+
 def test_fp16_scaler_runs_and_is_finite(devices8):
     mesh = build_mesh({}, devices=devices8[:1])
     cfg = tiny_cfg(dtype="float16")
