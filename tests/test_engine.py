@@ -166,3 +166,35 @@ def test_fp16_scaler_runs_and_is_finite(devices8):
     cfg["Engine"]["mix_precision"] = {"use_pure_fp16": True, "scale_loss": 1024}
     losses = run_losses(cfg, mesh, 3)
     assert all(np.isfinite(losses))
+
+
+def test_fp16_overflow_skips_step_and_backs_off_scale(devices8):
+    """An absurd initial loss scale overflows the scaled grads: every update
+    in a one-shot pass must be skipped (state.step frozen at 0) while the
+    scale halves per overflow (reference GradScaler). A second engine with a
+    sane scale must reach max_steps over the same stream."""
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg(dtype="float16")
+    cfg["Engine"]["mix_precision"] = {"use_pure_fp16": True,
+                                      "scale_loss": 2.0 ** 125}
+    eng = build_engine(cfg, mesh)
+    eng.max_steps = 10
+    batches = make_batches(10)
+    eng.prepare(batches[0])
+    assert float(jax.device_get(eng.state.scaler.loss_scale)) == 2.0 ** 125
+    eng.fit(iter(batches))  # one-shot: exactly 10 batches, all overflowing
+    final_step = int(jax.device_get(eng.state.step))
+    final_scale = float(jax.device_get(eng.state.scaler.loss_scale))
+    assert final_step == 0, final_step          # every update skipped
+    assert final_scale == 2.0 ** 115, final_scale  # halved once per batch
+    # params untouched and finite despite the overflow burst
+    for leaf in jax.tree.leaves(eng.state.params):
+        assert np.isfinite(np.asarray(jax.device_get(leaf))).all()
+
+    # with a list (re-iterable) loader, fit keeps feeding batches until
+    # max_steps OPTIMIZER steps complete — the scale recovers into range
+    eng2 = build_engine(cfg, mesh)
+    eng2.max_steps = 5
+    eng2.fit(batches)
+    assert int(jax.device_get(eng2.state.step)) == 5
+    assert float(jax.device_get(eng2.state.scaler.loss_scale)) < 2.0 ** 125
