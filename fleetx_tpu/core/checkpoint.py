@@ -30,6 +30,7 @@ except ImportError:  # pragma: no cover
 
 _META_NAME = "fleetx_meta.json"
 _checkpointer = None
+_pending: list[tuple[str, dict]] = []
 
 
 def _get_checkpointer():
@@ -46,25 +47,53 @@ def _step_dir(directory: str, step: int) -> str:
 
 
 def save_checkpoint(directory: str, step: int, state: Any,
-                    meta: Optional[dict] = None) -> str:
+                    meta: Optional[dict] = None,
+                    async_save: bool = False) -> str:
     """Write a sharded checkpoint for ``step`` under ``directory``.
 
     A step dir without its meta file is a half-written save (e.g. a
     preemption between the state write and the meta write); it is removed
     and overwritten rather than left to block every later save at this step.
+
+    ``async_save``: return as soon as device arrays are snapshotted — disk
+    I/O overlaps subsequent training steps. The meta file (the completion
+    marker) is written by ``finalize_async_saves``, which callers invoke
+    before the next save and at shutdown; an unfinalized save is simply a
+    half-written checkpoint the next run cleans up.
     """
+    finalize_async_saves()  # at most one outstanding async save
     path = os.path.abspath(_step_dir(directory, step))
     if os.path.isdir(path) and not os.path.exists(os.path.join(path, _META_NAME)):
         logger.info("removing half-written checkpoint: %s", path)
         shutil.rmtree(path)
     ckptr = _get_checkpointer()
     ckptr.save(os.path.join(path, "state"), state, force=True)
+    full_meta = dict(meta or {}, step=int(step))
+    if async_save:
+        _pending.append((path, full_meta))
+        logger.info("async checkpoint started: %s", path)
+        return path
     ckptr.wait_until_finished()
-    if jax.process_index() == 0:
-        with open(os.path.join(path, _META_NAME), "w") as f:
-            json.dump(dict(meta or {}, step=int(step)), f)
+    _write_meta(path, full_meta)
     logger.info("saved checkpoint: %s", path)
     return path
+
+
+def _write_meta(path: str, meta: dict) -> None:
+    if jax.process_index() == 0:
+        with open(os.path.join(path, _META_NAME), "w") as f:
+            json.dump(meta, f)
+
+
+def finalize_async_saves() -> None:
+    """Block until outstanding async saves are durable and mark them complete."""
+    if not _pending:
+        return
+    _get_checkpointer().wait_until_finished()
+    while _pending:
+        path, meta = _pending.pop(0)
+        _write_meta(path, meta)
+        logger.info("async checkpoint finalized: %s", path)
 
 
 def latest_step(directory: str) -> Optional[int]:

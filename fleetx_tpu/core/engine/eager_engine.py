@@ -96,6 +96,7 @@ class EagerEngine(BasicEngine):
         self.save_steps = _int(save_load, "save_steps", 0)
         self.output_dir = save_load.get("output_dir", "./output")
         self.ckpt_dir = save_load.get("ckpt_dir")
+        self.async_save = bool(save_load.get("async_save"))
 
         mp_cfg = dict(eng.get("mix_precision") or {})
         self.use_fp16_scaler = bool(mp_cfg.get("use_pure_fp16")) and (
@@ -416,6 +417,7 @@ class EagerEngine(BasicEngine):
             if self._profiling:
                 jax.profiler.stop_trace()
                 self._profiling = False
+            ckpt_lib.finalize_async_saves()
             return losses
 
     # ---------------------------------------------------------------- eval
@@ -485,10 +487,12 @@ class EagerEngine(BasicEngine):
         return ckpt_lib.save_checkpoint(
             self.output_dir, step, meta.unbox(self.state),
             meta={"consumed_samples": self._consumed_samples,
-                  "epoch": self._start_epoch, "seed": self.seed})
+                  "epoch": self._start_epoch, "seed": self.seed},
+            async_save=self.async_save)
 
     def load(self, directory: Optional[str] = None):
         """Restore the latest checkpoint (reference ``eager_engine.py:617-660``)."""
+        ckpt_lib.finalize_async_saves()
         directory = directory or self.output_dir
         step = ckpt_lib.latest_step(directory)
         if step is None:

@@ -62,3 +62,53 @@ def test_resume_skips_when_done(devices8, tmp_path):
     eng2 = build_engine(cfg2, mesh)
     out_losses = eng2.fit(make_batches(2))
     assert not out_losses  # checkpoint already at max_steps -> nothing to do
+
+
+def test_async_save_resume(tmp_path, devices8):
+    """async_save overlaps I/O with training; the kill-and-resume contract
+    (meta written last) still holds after finalize."""
+    import jax
+    from fleetx_tpu.core import checkpoint as ckpt_lib
+    from fleetx_tpu.core.engine import EagerEngine
+    from fleetx_tpu.core.module import GPTModule
+    from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+    from fleetx_tpu.optims.optimizer import build_optimizer
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    out = str(tmp_path / "ckpt")
+    cfg = {
+        "Model": dict(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_attention_heads=2, max_position_embeddings=16,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      use_flash_attention=False, dtype="float32",
+                      param_dtype="float32"),
+        "Engine": {"max_steps": 4, "logging_freq": 1,
+                   "save_load": {"save_steps": 2, "output_dir": out,
+                                 "async_save": True}},
+        "Global": {"seed": 0},
+    }
+
+    def make_engine():
+        module = GPTModule(cfg)
+        lr = build_lr_scheduler({"max_lr": 1e-3, "warmup_steps": 1,
+                                 "decay_steps": 10})
+        opt = build_optimizer({"name": "AdamW"}, lr)
+        return EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr,
+                           mesh=build_mesh({}, devices=devices8[:1]))
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 64, size=(4, 16)).astype(np.int32)
+    b = {"tokens": tokens,
+         "position_ids": np.broadcast_to(np.arange(16, dtype=np.int32),
+                                         (4, 16)).copy(),
+         "labels": tokens, "loss_mask": np.ones((4, 16), np.float32)}
+
+    eng = make_engine()
+    eng.fit([b] * 4)
+    assert ckpt_lib.latest_step(out) == 4
+
+    eng2 = make_engine()
+    eng2.prepare(b)
+    assert eng2.load(out)
+    assert int(jax.device_get(eng2.state.step)) == 4
