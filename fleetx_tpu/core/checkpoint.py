@@ -145,17 +145,83 @@ def load_params(directory: str, step: Optional[int] = None) -> Any:
     return state["params"]
 
 
-def load_checkpoint(directory: str, step: int, abstract_state: Any) -> tuple[Any, dict]:
+def load_checkpoint(directory: str, step: int, abstract_state: Any,
+                    adapt_layout: bool = False) -> tuple[Any, dict]:
     """Restore a checkpoint, re-sharding to ``abstract_state``'s shardings.
 
     ``abstract_state`` is a pytree of ``jax.ShapeDtypeStruct`` leaves carrying
     ``sharding`` attributes (the engine builds it from its mesh) — Orbax loads
     each shard directly onto its destination devices.
+
+    ``adapt_layout``: when a leaf's stored shape differs from the requested
+    one only by a reshape of the leading (stage/repeat/layer) dims — the
+    pipeline layouts ``[L] / [S, L/S] / [V, S, L/(V*S)]`` — restore with the
+    stored shape and reshape. The reference cannot restore across
+    topologies at all (per-rank dirs must match, ``eager_engine.py:617-660``).
     """
     path = os.path.abspath(_step_dir(directory, step))
     ckptr = _get_checkpointer()
-    state = ckptr.restore(os.path.join(path, "state"), abstract_state)
+    request = abstract_state
+    reshaped: list[str] = []
+    if adapt_layout:
+        import re
+
+        def norm(kp) -> str:
+            # attribute vs dict-key paths must compare equal
+            # (".params['gpt']" == "['params']['gpt']")
+            return re.sub(r"\W+", "/", jax.tree_util.keystr(kp)).strip("/")
+
+        md = ckptr.metadata(os.path.join(path, "state"))
+        stored = getattr(md, "item_metadata", md)
+        stored_leaves = {}
+
+        def record(kp, m):
+            if hasattr(m, "shape"):
+                stored_leaves[norm(kp)] = tuple(m.shape)
+            return m
+
+        jax.tree_util.tree_map_with_path(
+            record, stored,
+            is_leaf=lambda m: hasattr(m, "shape") and hasattr(m, "dtype"))
+
+        def adapt(kp, want):
+            key = norm(kp)
+            have = stored_leaves.get(key)
+            if have is None or tuple(want.shape) == have:
+                return want
+            # compatible iff both flatten to the same total with identical
+            # trailing (feature) dims — i.e. only the stage split differs
+            import numpy as np
+            if int(np.prod(have)) == int(np.prod(want.shape)):
+                reshaped.append(key)
+                sharding = None
+                if getattr(want, "sharding", None) is not None:
+                    # restore replicated on the same mesh; the engine
+                    # re-places the adapted state onto its shardings
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    sharding = NamedSharding(want.sharding.mesh,
+                                             PartitionSpec())
+                return jax.ShapeDtypeStruct(have, want.dtype,
+                                            sharding=sharding)
+            return want
+
+        request = jax.tree_util.tree_map_with_path(adapt, abstract_state)
+
+    state = ckptr.restore(os.path.join(path, "state"), request)
+    if reshaped:
+        logger.info("adapting pipeline layout of %d leaves on restore",
+                    len(reshaped))
+        state = jax.tree.map(
+            lambda got, want: jnp_reshape_to(got, want.shape)
+            if got.shape != want.shape else got,
+            state, abstract_state)
     with open(os.path.join(path, _META_NAME)) as f:
         meta = json.load(f)
     logger.info("restored checkpoint: %s (step %d)", path, meta.get("step", step))
     return state, meta
+
+
+def jnp_reshape_to(arr: Any, shape: tuple) -> Any:
+    import jax.numpy as jnp
+
+    return jnp.reshape(arr, shape)

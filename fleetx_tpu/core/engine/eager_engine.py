@@ -491,7 +491,13 @@ class EagerEngine(BasicEngine):
             async_save=self.async_save)
 
     def load(self, directory: Optional[str] = None):
-        """Restore the latest checkpoint (reference ``eager_engine.py:617-660``)."""
+        """Restore the latest checkpoint (reference ``eager_engine.py:617-660``).
+
+        Cross-topology: a checkpoint written under a different pipeline
+        layout (layer stacks ``[L]`` vs ``[S, L/S]`` vs ``[V, S, L/(V*S)]``)
+        is adapted by reshaping leading dims — train with pp, eval without,
+        or re-partition stages between runs.
+        """
         ckpt_lib.finalize_async_saves()
         directory = directory or self.output_dir
         step = ckpt_lib.latest_step(directory)
@@ -501,12 +507,16 @@ class EagerEngine(BasicEngine):
         abstract = jax.tree.map(
             lambda s, x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             self.state_shardings, meta.unbox(jax.eval_shape(lambda: self.state)))
-        state, meta_d = ckpt_lib.load_checkpoint(directory, step, abstract)
+        state, meta_d = ckpt_lib.load_checkpoint(directory, step, abstract,
+                                                 adapt_layout=True)
         # re-box: restored leaves are raw arrays; re-attach logical metadata
         self.state = jax.tree.map(
             lambda box, leaf: box.replace_boxed(leaf) if isinstance(box, meta.AxisMetadata) else leaf,
             jax.eval_shape(lambda: self.state), state,
             is_leaf=lambda x: isinstance(x, meta.AxisMetadata))
+        # layout-adapted leaves come back replicated — re-place on the mesh
+        with self._ctx():
+            self.state = jax.device_put(self.state, self.state_shardings)
         self._consumed_samples = int(meta_d.get("consumed_samples", 0))
         self._start_epoch = int(meta_d.get("epoch", 0))
         return True

@@ -112,3 +112,70 @@ def test_async_save_resume(tmp_path, devices8):
     eng2.prepare(b)
     assert eng2.load(out)
     assert int(jax.device_get(eng2.state.step)) == 4
+
+
+def test_cross_topology_restore_pp_to_single(tmp_path, devices8):
+    """Train 2 steps under pp2, restore into a non-pipelined single-device
+    engine: the loss curve continues as if never interrupted."""
+    import jax
+    from fleetx_tpu.core.engine import EagerEngine
+    from fleetx_tpu.core.module import GPTModule
+    from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+    from fleetx_tpu.optims.optimizer import build_optimizer
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    out = str(tmp_path / "ckpt")
+    model = dict(vocab_size=64, hidden_size=32, num_layers=4,
+                 num_attention_heads=2, max_position_embeddings=16,
+                 hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                 use_flash_attention=False, dtype="float32",
+                 param_dtype="float32")
+
+    def make(pp):
+        cfg = {"Model": dict(model),
+               "Engine": {"max_steps": 4, "logging_freq": 1,
+                          "accumulate_steps": 2,
+                          "save_load": {"save_steps": 2, "output_dir": out}},
+               "Global": {"seed": 0}}
+        if pp > 1:
+            cfg["Distributed"] = {"pp_degree": pp}
+        module = GPTModule(cfg)
+        lr = build_lr_scheduler({"max_lr": 1e-3, "warmup_steps": 1,
+                                 "decay_steps": 10})
+        opt = build_optimizer({"name": "AdamW"}, lr)
+        mesh = build_mesh(cfg.get("Distributed"),
+                          devices=devices8 if pp > 1 else devices8[:1])
+        return EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr,
+                           mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 64, size=(8, 16)).astype(np.int32)
+    b = {"tokens": tokens,
+         "position_ids": np.broadcast_to(np.arange(16, dtype=np.int32),
+                                         (8, 16)).copy(),
+         "labels": np.roll(tokens, -1, axis=1),
+         "loss_mask": np.ones((8, 16), np.float32)}
+
+    pp_eng = make(2)
+    pp_eng.max_steps = 2
+    pp_eng.fit([b, b])
+    pp_eng.save()
+    pp_params = jax.device_get(pp_eng.state.params)
+
+    single = make(1)
+    single.prepare(b)
+    assert single.load(out)
+    assert int(jax.device_get(single.state.step)) == 2
+    # layer stacks reshaped [2, 2, ...] -> [4, ...] with identical values
+    from flax.core import meta as fmeta
+    from fleetx_tpu.parallel.pipeline import split_stage_params
+
+    restored = fmeta.unbox(jax.device_get(single.state.params))
+    staged = split_stage_params(restored["gpt"]["layers"], 2)
+    jax.tree.map(
+        lambda a, c: np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                                rtol=0, atol=0),
+        fmeta.unbox(pp_params)["gpt"]["layers"], staged)
+    # and training continues
+    losses = single.fit([b, b])
+    assert losses and all(np.isfinite(losses))
