@@ -189,8 +189,19 @@ class GPTModule(LanguageModule):
         from fleetx_tpu.models.gpt.model import cross_entropy_loss
 
         dropout_rng = jax.random.fold_in(rng, step)
+        variables = {"params": meta.unbox(params)}
+        if self.model_cfg.moe_num_experts > 0:
+            logits, aux_vars = self.model.apply(
+                variables, batch["tokens"], batch["position_ids"],
+                deterministic=False, rngs={"dropout": dropout_rng},
+                mutable=["losses"])
+            loss = cross_entropy_loss(logits, batch["labels"],
+                                      batch["loss_mask"])
+            aux = sum(jnp.sum(l) for l in
+                      jax.tree.leaves(aux_vars.get("losses", {})))
+            return loss + aux, {"loss": loss, "moe_aux": aux}
         logits = self.model.apply(
-            {"params": meta.unbox(params)}, batch["tokens"], batch["position_ids"],
+            variables, batch["tokens"], batch["position_ids"],
             deterministic=False, rngs={"dropout": dropout_rng})
         loss = cross_entropy_loss(logits, batch["labels"], batch["loss_mask"])
         return loss, {"loss": loss}

@@ -64,6 +64,10 @@ class GPTConfig:
     use_ring_attention: bool = False  # context parallelism over the seq axis
     use_qat: bool = False      # int8 fake-quant on linears (ops/quantization.py)
     qat_bits: int = 8
+    moe_num_experts: int = 0   # 0 = dense FFN; >0 = MoE (models/gpt/moe.py)
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     pp_degree: int = 1         # pipeline stages (reference pp_degree)
     pp_microbatches: int = 0   # 0 → defaults to pp_degree (ref accumulate_steps)
     virtual_pp_degree: int = 1  # interleaved chunks/device (ref virtual pp)
@@ -345,7 +349,12 @@ class TransformerDecoderLayer(nn.Module):
 
         residual = x
         y = LayerNorm(cfg, name="ln2")(x)
-        y = GPTMlp(cfg, name="mlp")(y)
+        if cfg.moe_num_experts > 0:
+            from fleetx_tpu.models.gpt.moe import MoEMlp
+
+            y = MoEMlp(cfg, name="mlp")(y)
+        else:
+            y = GPTMlp(cfg, name="mlp")(y)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=False)
         x = residual + y
@@ -442,7 +451,7 @@ class GPTModel(nn.Module):
 
             stack = nn.scan(
                 layer,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(0, nn.broadcast, nn.broadcast),
                 out_axes=0,

@@ -61,6 +61,10 @@ def make_axis_rules(dist_config: dict | None = None) -> tuple[tuple[str, Any], .
         ("act_heads", "tensor"),
         ("act_kv", None),
         ("act_vocab", "tensor"),
+        # expert parallelism (MoE — capability beyond the reference): expert
+        # weights and the dispatched activations shard over the tensor axis
+        ("expert", "tensor"),
+        ("act_expert", "tensor"),
     ]
     return tuple(rules)
 
