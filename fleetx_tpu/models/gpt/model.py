@@ -429,6 +429,8 @@ class GPTModel(nn.Module):
                 make_stage_stack, pipeline_apply)
 
             assert attention_mask is None, "pipeline mode is training-only"
+            assert cfg.moe_num_experts == 0, \
+                "MoE is not supported under pipeline parallelism yet"
             V = max(cfg.virtual_pp_degree, 1)
             chunks = cfg.pp_degree * V
             assert cfg.num_layers % chunks == 0
