@@ -191,15 +191,27 @@ def main():
         return 0
 
     errors = []
+    # total wall budget: the driver kills long benches, and a dead TPU
+    # tunnel can eat unbounded time in backend init — reserve enough of the
+    # budget that the cpu fallback always gets to print a JSON line
+    budget = float(os.environ.get("FLEETX_BENCH_BUDGET", 2100.0))
+    t0 = time.monotonic()
+
+    def remaining() -> float:
+        return budget - (time.monotonic() - t0)
+
     # accelerator attempts: fastest recompute policy first ("dots" keeps
-    # matmul outputs; may OOM on 16G — "full" remat always fits). Backend
-    # init has been observed to BLOCK for 25+ min when the TPU tunnel is
-    # down — cap each attempt so the cpu fallback still runs.
+    # matmul outputs; may OOM on 16G — "full" remat always fits)
+    cpu_reserve = 700.0
     for attempt, (backoff, gran) in enumerate(((0, "dots"), (15, "full"))):
+        per_attempt = min(900.0, remaining() - cpu_reserve)
+        if per_attempt < 120.0:
+            errors.append(f"[{gran}] skipped (budget)")
+            continue
         if backoff:
             time.sleep(backoff)
         result, err = _run_child({"FLEETX_BENCH_RECOMPUTE": gran},
-                                 timeout=900.0)
+                                 timeout=per_attempt)
         if result is not None:
             result["attempt"] = attempt + 1
             result["recompute"] = gran
@@ -207,7 +219,8 @@ def main():
             return 0
         errors.append(f"[{gran}] {err}")
     # fallback: cpu backend so the round still records a real measurement
-    result, err = _run_child({"JAX_PLATFORMS": "cpu"}, timeout=1500.0,
+    result, err = _run_child({"JAX_PLATFORMS": "cpu"},
+                             timeout=max(remaining() - 30.0, 120.0),
                              scrub_plugin=True)
     if result is not None:
         result["note"] = "accelerator init failed; cpu fallback"
