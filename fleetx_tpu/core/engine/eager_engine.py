@@ -50,6 +50,7 @@ class ScalerState(struct.PyTreeNode):
 
 
 class TrainState(struct.PyTreeNode):
+    """Jitted training state: step, params, optimizer state, fp16 scaler."""
     step: jax.Array            # i32 scalar
     params: Any                # boxed (nn.Partitioned) param pytree
     opt_state: Any

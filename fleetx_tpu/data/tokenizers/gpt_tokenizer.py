@@ -93,6 +93,7 @@ class GPTTokenizer:
                               os.path.join(path, "merges.txt"))
 
     def save_pretrained(self, path: str) -> None:
+        """Write vocab.json + merges.txt under ``path``."""
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "vocab.json"), "w", encoding="utf-8") as f:
             json.dump(self.encoder, f, ensure_ascii=False)
@@ -108,6 +109,7 @@ class GPTTokenizer:
 
     # -- core ----------------------------------------------------------------
     def bpe(self, token: str) -> str:
+        """Greedy merge loop over one pre-token (canonical GPT-2 BPE)."""
         if token in self.cache:
             return self.cache[token]
         word = tuple(token)
@@ -144,6 +146,7 @@ class GPTTokenizer:
         return out
 
     def encode(self, text: str) -> list[int]:
+        """Text -> token ids."""
         ids: list[int] = []
         for tok in PRETOKENIZE_PAT.findall(text):
             mapped = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
@@ -151,6 +154,7 @@ class GPTTokenizer:
         return ids
 
     def decode(self, ids) -> str:
+        """Token ids -> text."""
         # ids beyond the vocab (model vocabs are padded past the tokenizer's,
         # e.g. 50304 vs 50257) decode to nothing rather than crashing
         text = "".join(self.decoder.get(int(i), "") for i in ids)

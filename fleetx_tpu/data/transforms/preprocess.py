@@ -64,6 +64,7 @@ class ResizeImage:
 
 
 class CenterCropImage:
+    """Center crop to ``size`` (reference CropImage)."""
     def __init__(self, size: int):
         self.size = size
 
@@ -101,6 +102,7 @@ class RandCropImage:
 
 
 class RandFlipImage:
+    """Random horizontal flip (reference RandFlipImage)."""
     def __init__(self, flip_code: int = 1, prob: float = 0.5):
         self.prob = prob
 

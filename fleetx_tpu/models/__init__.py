@@ -10,6 +10,7 @@ __all__ = ["build_module", "get_registry"]
 
 
 def get_registry():
+    """Name → task-module class map (lazy imports keep startup light)."""
     from fleetx_tpu.core.module import GPTModule
 
     modules = {"GPTModule": GPTModule}

@@ -26,6 +26,7 @@ with_logical = nn.with_logical_constraint
 
 @dataclasses.dataclass(unsafe_hash=True)
 class ErnieConfig:
+    """Architecture config (reference yaml ``Model:`` section)."""
     vocab_size: int = 40000
     hidden_size: int = 768
     num_layers: int = 12
@@ -56,6 +57,7 @@ def _init(cfg: ErnieConfig):
 
 
 class ErnieLayerNorm(nn.Module):
+    """Post-LN layer norm in f32 (BERT-style encoder)."""
     cfg: ErnieConfig
 
     @nn.compact
@@ -73,6 +75,7 @@ class ErnieLayerNorm(nn.Module):
 
 
 class ErnieSelfAttention(nn.Module):
+    """Bidirectional self-attention with padding mask."""
     cfg: ErnieConfig
 
     @nn.compact
@@ -273,6 +276,7 @@ def pretraining_criterion(mlm_logits: jax.Array, nsp_logits: jax.Array,
 
 
 def config_from_dict(d: dict) -> ErnieConfig:
+    """Build an ErnieConfig from a YAML ``Model:`` section."""
     known = {f.name for f in dataclasses.fields(ErnieConfig)}
     kwargs = {k: v for k, v in d.items() if k in known and v is not None}
     dtype_map = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,

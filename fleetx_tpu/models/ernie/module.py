@@ -15,6 +15,7 @@ from fleetx_tpu.utils.log import logger
 
 
 class ErnieModule(BasicModule):
+    """ERNIE pretraining task: MLM + NSP losses (reference ernie_module.py)."""
     def __init__(self, cfg: Any):
         model_cfg = cfg.get("Model", cfg) if isinstance(cfg, dict) else cfg
         self.model_cfg = config_from_dict(dict(model_cfg))

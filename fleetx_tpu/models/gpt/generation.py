@@ -123,12 +123,14 @@ def hamming_diversity_processor(diversity_rate: float, num_beams: int,
 
 
 def apply_temperature(logits: jax.Array, temperature: float) -> jax.Array:
+    """Scale logits by 1/temperature (no-op at 1.0)."""
     if temperature in (None, 1.0):
         return logits
     return logits / jnp.maximum(jnp.float32(temperature), 1e-6)
 
 
 def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Mask everything below the k-th largest logit."""
     if not k or k <= 0:
         return logits
     k = min(int(k), logits.shape[-1])

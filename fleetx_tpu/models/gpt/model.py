@@ -104,6 +104,7 @@ class DecodeCache:
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int,
                dtype: Any = None) -> DecodeCache:
+    """Allocate an empty decode cache for ``batch`` rows of ``max_len``."""
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch, max_len, cfg.num_attention_heads, cfg.head_dim)
     return DecodeCache(key=jnp.zeros(shape, dtype), value=jnp.zeros(shape, dtype),
@@ -299,6 +300,7 @@ class GPTMlp(nn.Module):
 
 
 class LayerNorm(nn.Module):
+    """Pre-norm layer norm computed in f32 (bf16-safe)."""
     cfg: GPTConfig
 
     @nn.compact

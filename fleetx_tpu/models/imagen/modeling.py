@@ -82,6 +82,7 @@ def q_sample(schedule: dict, x0: jax.Array, t: jax.Array,
 
 def predict_x0(schedule: dict, cfg: DiffusionConfig, x_t: jax.Array,
                t: jax.Array, pred: jax.Array) -> jax.Array:
+    """Recover x0 from the network prediction (eps or v objective)."""
     ab = _gather(schedule["alpha_bar"], t, x_t.ndim)
     if cfg.pred_type == "v":
         return jnp.sqrt(ab) * x_t - jnp.sqrt(1.0 - ab) * pred

@@ -25,6 +25,7 @@ with_logical = nn.with_logical_constraint
 
 @dataclasses.dataclass(unsafe_hash=True)
 class ViTConfig:
+    """Architecture config (reference ViT factory kwargs)."""
     image_size: int = 224
     patch_size: int = 16
     in_channels: int = 3
@@ -109,6 +110,7 @@ class ViTAttention(nn.Module):
 
 
 class ViTMlp(nn.Module):
+    """Dense GELU MLP block."""
     cfg: ViTConfig
 
     @nn.compact
@@ -132,6 +134,7 @@ class ViTMlp(nn.Module):
 
 
 class ViTLayerNorm(nn.Module):
+    """Layer norm in f32 (bf16-safe)."""
     cfg: ViTConfig
 
     @nn.compact
@@ -266,6 +269,7 @@ PRESETS = {
 
 
 def build_vit(name: str, **overrides) -> ViT:
+    """Name -> ViT preset factory (reference vit.py:261-431)."""
     preset = dict(PRESETS.get(name) or {})
     if not preset and name != "ViT":
         raise ValueError(f"unknown ViT preset {name!r}; have {sorted(PRESETS)}")
@@ -274,6 +278,7 @@ def build_vit(name: str, **overrides) -> ViT:
 
 
 def config_from_dict(d: dict) -> ViTConfig:
+    """Build a ViTConfig from a YAML ``Model:`` section."""
     known = {f.name for f in dataclasses.fields(ViTConfig)}
     kwargs = {k: v for k, v in d.items() if k in known and v is not None}
     dtype_map = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,

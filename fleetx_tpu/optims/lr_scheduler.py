@@ -58,6 +58,7 @@ def vit_lr(learning_rate: float, total_steps: int, warmup_steps: int = 0,
 
 
 def constant_lr(learning_rate: float):
+    """Fixed learning rate schedule."""
     def schedule(step):
         return jnp.full((), learning_rate, jnp.float32)
 

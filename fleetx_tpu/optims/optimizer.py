@@ -70,6 +70,7 @@ def adamw(learning_rate, *, beta1: float = 0.9, beta2: float = 0.999,
 
 def sgd(learning_rate, *, momentum: float = 0.9,
         grad_clip: float | None = None) -> optax.GradientTransformation:
+    """Plain SGD with optional momentum (reference Momentum optimizer)."""
     chain = []
     if grad_clip is not None and grad_clip > 0:
         chain.append(optax.clip_by_global_norm(grad_clip))

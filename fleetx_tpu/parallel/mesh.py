@@ -106,12 +106,14 @@ def build_mesh(dist_config: dict | None = None, devices: list | None = None) -> 
 
 
 def set_mesh(mesh: Mesh) -> Mesh:
+    """Install ``mesh`` as the process-global default."""
     global _global_mesh
     _global_mesh = mesh
     return mesh
 
 
 def get_mesh() -> Mesh:
+    """The process-global mesh (built from all devices on first use)."""
     global _global_mesh
     if _global_mesh is None:
         _global_mesh = build_mesh()

@@ -10,6 +10,7 @@ MIN_JAX = (0, 4, 35)
 
 
 def check_version() -> bool:
+    """Warn when the installed jax predates the supported minimum."""
     import jax
 
     parts = tuple(int(p) for p in jax.__version__.split(".")[:3])

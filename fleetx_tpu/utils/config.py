@@ -60,6 +60,7 @@ class AttrDict(dict):
 
 
 def create_attr_dict(d: dict) -> AttrDict:
+    """Recursively wrap nested dicts as AttrDict in place."""
     out = AttrDict()
     for k, v in d.items():
         out[k] = create_attr_dict(v) if isinstance(v, dict) else v
@@ -208,6 +209,7 @@ def process_global_configs(config: AttrDict) -> AttrDict:
 
 
 def process_engine_config(config: AttrDict) -> AttrDict:
+    """Fill Engine defaults (reference process_engine_config)."""
     eng = config.setdefault("Engine", AttrDict())
     eng.setdefault("run_mode", "step")
     eng.setdefault("num_train_epochs", 1)

@@ -29,6 +29,7 @@ _RESET = "\033[0m"
 
 class _ColorFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
+        """Inject the level color codes into the record."""
         msg = super().format(record)
         if sys.stderr.isatty():
             color = _COLORS.get(record.levelname, "")
