@@ -8,6 +8,7 @@ dataset contract (synthetic + TSV round trip).
 
 import base64
 import io
+import os
 
 import jax
 import numpy as np
@@ -85,6 +86,31 @@ def test_sr_stage_trains_with_lowres_conditioning(devices8):
     _, _, losses = _train(cfg, mesh, [batch] * 5, n=5)
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_cascade_sampling_base_to_sr(devices8):
+    """Base stage output feeds the SR stage's lowres conditioning
+    (tasks/imagen/generate.py cascade)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tasks", "imagen"))
+    import generate as imagen_generate
+
+    base_cfg = _cfg(timesteps=8)
+    sr_cfg = _cfg(preset="sr256", dim=16, dim_mults=[1, 2],
+                  layer_attns=[False, False], layer_cross_attns=[False, True],
+                  lowres_cond=True, image_size=32, timesteps=8)
+    stages = [imagen_generate.load_stage(base_cfg),
+              imagen_generate.load_stage(sr_cfg)]
+    rng = np.random.RandomState(0)
+    text = rng.randn(2, 4, 24).astype(np.float32)
+    mask = np.ones((2, 4), np.int32)
+    images = imagen_generate.sample_cascade(
+        stages, jax.random.PRNGKey(0), 2, text, mask)
+    images = np.asarray(images)
+    assert images.shape == (2, 32, 32, 3)
+    assert np.isfinite(images).all() and np.abs(images).max() <= 1.0
 
 
 def test_imagen_tsv_dataset_roundtrip(tmp_path):
