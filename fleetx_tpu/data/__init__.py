@@ -8,6 +8,8 @@ code execution.
 from __future__ import annotations
 
 from fleetx_tpu.data.dataloader import DataLoader, default_collate
+from fleetx_tpu.data.dataset.ernie_dataset import (
+    ErnieDataset, SyntheticErnieDataset)
 from fleetx_tpu.data.dataset.gpt_dataset import (
     BlendedDataset, GPTDataset, SyntheticGPTDataset, write_corpus)
 from fleetx_tpu.data.dataset.multimodal_dataset import (
@@ -20,6 +22,8 @@ from fleetx_tpu.data.sampler.batch_sampler import (
 DATASETS = {"GPTDataset": GPTDataset,
             "SyntheticGPTDataset": SyntheticGPTDataset,
             "BlendedDataset": BlendedDataset,
+            "ErnieDataset": ErnieDataset,
+            "SyntheticErnieDataset": SyntheticErnieDataset,
             "GeneralClsDataset": GeneralClsDataset,
             "CIFAR10": CIFAR10,
             "SyntheticVisionDataset": SyntheticVisionDataset,
@@ -53,15 +57,17 @@ def build_dataset(cfg: dict, mode: str = "Train", **overrides):
     input_dir = section.pop("input_dir", None)
     if input_dir is not None and "data_prefix" not in section:
         section["data_prefix"] = input_dir
-    if name in ("GPTDataset", "SyntheticGPTDataset"):
+    seq_named = ("GPTDataset", "SyntheticGPTDataset", "ErnieDataset",
+                 "SyntheticErnieDataset")
+    if name in seq_named:
         section.setdefault("seq_length", section.pop("max_seq_len", 1024))
     else:  # vision/multimodal datasets have no sequence axis
         section.pop("seq_length", None)
         section.pop("max_seq_len", None)
-    if name != "SyntheticGPTDataset":
-        # vocab_size is plumbed from Model config for the synthetic stream
-        # (token range must match the embedding table); real datasets carry
-        # their own vocabulary
+    if name not in ("SyntheticGPTDataset", "ErnieDataset",
+                    "SyntheticErnieDataset"):
+        # vocab_size is plumbed from Model config (token range must match
+        # the embedding table); other datasets carry their own vocabulary
         section.pop("vocab_size", None)
     return cls(**section)
 

@@ -1,0 +1,121 @@
+"""ERNIE masked-LM pretraining datasets.
+
+The reference's ``ernie_dataset.py`` is a 20-line stub (it never shipped a
+working ERNIE data path); here the batch contract the model needs —
+``input_ids / token_type_ids / attention_mask / mlm_labels /
+next_sentence_labels`` — is produced two ways:
+
+- ``ErnieDataset``: BERT-style dynamic masking over the same memmap
+  ``{prefix}_ids.npy`` / ``{prefix}_idx.npz`` pair the GPT pipeline uses
+  (tools/preprocess_data.py output): 15% of positions masked (80% [MASK],
+  10% random, 10% kept), sentence-pair rows with a random 50% swap for the
+  next-sentence objective.
+- ``SyntheticErnieDataset``: deterministic random batches for smoke runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def apply_mlm_mask(tokens: np.ndarray, rng: np.random.RandomState, *,
+                   vocab_size: int, mask_id: int, mask_prob: float = 0.15,
+                   special_ids: tuple = ()) -> tuple[np.ndarray, np.ndarray]:
+    """BERT masking: returns (masked_tokens, mlm_labels) with -100 on
+    unmasked positions (ignored by the criterion)."""
+    tokens = tokens.copy()
+    labels = np.full_like(tokens, -100)
+    maskable = ~np.isin(tokens, list(special_ids))
+    pick = (rng.rand(*tokens.shape) < mask_prob) & maskable
+    labels[pick] = tokens[pick]
+    roll = rng.rand(*tokens.shape)
+    tokens[pick & (roll < 0.8)] = mask_id
+    rand_pick = pick & (roll >= 0.8) & (roll < 0.9)
+    tokens[rand_pick] = rng.randint(0, vocab_size, rand_pick.sum())
+    return tokens, labels
+
+
+class ErnieDataset:
+    """Sentence-pair masked-LM dataset over a memmap token stream."""
+
+    def __init__(self, data_prefix: str, *, num_samples: int,
+                 seq_length: int = 512, vocab_size: int = 40000,
+                 seed: int = 1234, cls_id: int = 1, sep_id: int = 2,
+                 mask_id: int = 3, **_unused):
+        self.tokens = np.load(data_prefix + "_ids.npy", mmap_mode="r")
+        idx = np.load(data_prefix + "_idx.npz")
+        self.doc_lens = idx["lens"].astype(np.int64)
+        self.doc_starts = np.concatenate([[0], np.cumsum(self.doc_lens)])
+        self.num_samples = int(num_samples)
+        self.seq_length = int(seq_length)
+        self.vocab_size = int(vocab_size)
+        self.seed = int(seed)
+        self.cls_id, self.sep_id, self.mask_id = cls_id, sep_id, mask_id
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def _segment(self, rng: np.random.RandomState, length: int) -> np.ndarray:
+        doc = int(rng.randint(0, len(self.doc_lens)))
+        start = int(self.doc_starts[doc])
+        dl = int(self.doc_lens[doc])
+        off = int(rng.randint(0, max(dl - length, 1)))
+        seg = np.asarray(self.tokens[start + off: start + off + length],
+                         np.int64)
+        if len(seg) < length:  # short doc: pad by wrapping
+            seg = np.pad(seg, (0, length - len(seg)), mode="wrap")
+        return seg
+
+    def __getitem__(self, i: int) -> dict:
+        rng = np.random.RandomState(self.seed + int(i))
+        s = self.seq_length
+        half = (s - 3) // 2
+        a = self._segment(rng, half)
+        b = self._segment(rng, s - 3 - half)
+        is_next = int(rng.rand() < 0.5)
+        if not is_next:
+            a, b = b, a  # "random" pair proxy: swapped order
+        ids = np.concatenate([[self.cls_id], a, [self.sep_id], b,
+                              [self.sep_id]]).astype(np.int64)
+        token_type = np.concatenate([
+            np.zeros(2 + len(a), np.int32), np.ones(len(b) + 1, np.int32)])
+        masked, labels = apply_mlm_mask(
+            ids, rng, vocab_size=self.vocab_size, mask_id=self.mask_id,
+            special_ids=(self.cls_id, self.sep_id))
+        return {
+            "input_ids": masked.astype(np.int32),
+            "token_type_ids": token_type,
+            "attention_mask": np.ones(s, np.int32),
+            "mlm_labels": labels.astype(np.int32),
+            "next_sentence_labels": np.int32(is_next),
+        }
+
+
+class SyntheticErnieDataset:
+    """Deterministic random masked-LM batches (zero data files)."""
+
+    def __init__(self, *, num_samples: int = 1024, seq_length: int = 512,
+                 vocab_size: int = 40000, seed: int = 1234, mask_id: int = 3,
+                 **_unused):
+        self.num_samples = int(num_samples)
+        self.seq_length = int(seq_length)
+        self.vocab_size = int(vocab_size)
+        self.seed = int(seed)
+        self.mask_id = mask_id
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, i: int) -> dict:
+        rng = np.random.RandomState(self.seed + int(i))
+        s = self.seq_length
+        ids = rng.randint(4, self.vocab_size, size=s).astype(np.int64)
+        masked, labels = apply_mlm_mask(ids, rng, vocab_size=self.vocab_size,
+                                        mask_id=self.mask_id)
+        return {
+            "input_ids": masked.astype(np.int32),
+            "token_type_ids": (np.arange(s) >= s // 2).astype(np.int32),
+            "attention_mask": np.ones(s, np.int32),
+            "mlm_labels": labels.astype(np.int32),
+            "next_sentence_labels": np.int32(rng.rand() < 0.5),
+        }

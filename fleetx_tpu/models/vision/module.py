@@ -29,8 +29,10 @@ class GeneralClsModule(BasicModule):
         preset.update({k: v for k, v in model_cfg.get("model", {}).items()
                        if v is not None} if isinstance(model_cfg.get("model"), dict)
                       else {})
-        for key in ("num_classes", "image_size", "drop_path_rate", "dtype",
-                    "param_dtype", "use_recompute", "scan_layers"):
+        for key in ("num_classes", "image_size", "patch_size", "num_layers",
+                    "hidden_size", "num_attention_heads", "mlp_ratio",
+                    "drop_path_rate", "dtype", "param_dtype", "use_recompute",
+                    "scan_layers"):
             if model_cfg.get(key) is not None:
                 preset[key] = model_cfg[key]
         self.vit_cfg = config_from_dict(preset)

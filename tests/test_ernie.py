@@ -102,3 +102,36 @@ def test_ernie_trains_sharded(devices8):
     losses = eng.fit([batch] * 4)
     assert abs(losses[0] - (np.log(VOCAB) + np.log(2))) < 0.7
     assert losses[-1] < losses[0]
+
+
+def test_ernie_datasets(tmp_path):
+    """MLM masking contract + memmap sentence-pair dataset."""
+    from fleetx_tpu.data.dataset.ernie_dataset import (
+        ErnieDataset, SyntheticErnieDataset, apply_mlm_mask)
+    from fleetx_tpu.data.dataset.gpt_dataset import write_corpus
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(4, 1000, size=(4, 64)).astype(np.int64)
+    masked, labels = apply_mlm_mask(tokens, rng, vocab_size=1000, mask_id=3)
+    picked = labels != -100
+    assert 0 < picked.sum() < tokens.size
+    # unmasked positions keep their tokens and are ignored by the loss
+    np.testing.assert_array_equal(masked[~picked], tokens[~picked])
+    # labels hold the ORIGINAL token at masked positions
+    np.testing.assert_array_equal(labels[picked],
+                                  tokens[picked])
+
+    ds = SyntheticErnieDataset(num_samples=8, seq_length=32, vocab_size=500)
+    s = ds[0]
+    assert s["input_ids"].shape == (32,) and s["mlm_labels"].shape == (32,)
+    assert s["next_sentence_labels"] in (0, 1)
+
+    docs = [list(rng.randint(4, 500, size=rng.randint(40, 80)))
+            for _ in range(6)]
+    prefix = str(tmp_path / "corpus")
+    write_corpus(prefix, docs)
+    real = ErnieDataset(prefix, num_samples=8, seq_length=32, vocab_size=500)
+    s = real[3]
+    assert s["input_ids"].shape == (32,)
+    assert s["input_ids"][0] == 1  # [CLS]
+    assert (s["mlm_labels"] != -100).sum() > 0
