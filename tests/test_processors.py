@@ -1,0 +1,59 @@
+"""Logits-processor unit tests (reference ``processor.py:22-199``)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from fleetx_tpu.models.gpt import generation as G
+
+
+def test_min_length_suppresses_eos():
+    proc = G.min_length_processor(min_length=4, eos_token_id=2)
+    logits = jnp.zeros((2, 8))
+    out = proc(logits, jnp.int32(1), None)
+    assert np.asarray(out)[0, 2] < -1e30 / 2
+    out = proc(logits, jnp.int32(5), None)
+    assert np.asarray(out)[0, 2] == 0.0
+
+
+def test_repetition_penalty_hits_seen_tokens():
+    proc = G.repetition_penalty_processor(2.0)
+    logits = jnp.ones((1, 6))
+    seqs = jnp.asarray([[3, 3, 4]], jnp.int32)
+    out = np.asarray(proc(logits, jnp.int32(2), seqs))
+    assert out[0, 3] == 0.5 and out[0, 4] == 0.5
+    assert out[0, 0] == 1.0
+
+
+def test_forced_bos_eos():
+    bos = G.forced_bos_processor(5)
+    out = np.asarray(bos(jnp.zeros((1, 8)), jnp.int32(0), None))
+    assert out[0, 5] == 0.0 and (out[0, :5] < -1e30).all()
+    # after the first step it's a no-op
+    out = np.asarray(bos(jnp.zeros((1, 8)), jnp.int32(1), None))
+    assert (out == 0).all()
+
+    eos = G.forced_eos_processor(max_length=4, eos_token_id=1)
+    out = np.asarray(eos(jnp.zeros((1, 8)), jnp.int32(3), None))
+    assert out[0, 1] == 0.0 and out[0, 0] < -1e30 / 2
+
+
+def test_hamming_diversity_penalises_earlier_groups_tokens():
+    # 1 batch row, 4 beams in 2 groups of 2
+    proc = G.hamming_diversity_processor(diversity_rate=1.5, num_beams=4,
+                                         num_beam_groups=2)
+    current = jnp.asarray([7, 3, 0, 0], jnp.int32)  # group 0 chose 7 and 3
+    logits = jnp.zeros((2, 10))  # current group's rows (group_size=2)
+    # group 1 sees penalties on 7 and 3
+    out = np.asarray(proc(logits, current, jnp.int32(1)))
+    assert out[0, 7] == -1.5 and out[0, 3] == -1.5 and out[1, 7] == -1.5
+    assert out[0, 0] == 0.0
+    # group 0 (no earlier groups) sees none
+    out0 = np.asarray(proc(logits, current, jnp.int32(0)))
+    assert (out0 == 0).all()
+
+
+def test_top_p_keeps_nucleus():
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    out = np.asarray(G.apply_top_p(logits, 0.7))
+    assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+    assert out[0, 3] < -1e30 / 2
