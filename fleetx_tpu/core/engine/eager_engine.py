@@ -131,7 +131,13 @@ class EagerEngine(BasicEngine):
 
         glb = dict(self.cfg.get("Global") or {})
         self.seed = int(glb.get("seed", 1234))
-        self._base_rng = jax.random.PRNGKey(self.seed)
+        # dropout-mask generation with the default threefry2x32 costs real
+        # step time on TPU (counter-based hashing on the VPU); Global.prng_impl
+        # lets throughput-focused recipes switch to the hardware-accelerated
+        # generators ("rbg"/"unsafe_rbg" — different stream, same statistics)
+        prng_impl = glb.get("prng_impl")
+        self._base_rng = (jax.random.key(self.seed, impl=str(prng_impl))
+                          if prng_impl else jax.random.PRNGKey(self.seed))
 
         # profiler window (reference Profiler: config block + paddle.profiler
         # integration, eager_engine.py:197-219,329-330,679-738)

@@ -420,6 +420,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         block_q = pick_block(sq, d)
     if block_k is None:
         block_k = pick_block(sk, d)
+    # a non-dividing explicit block would floor away whole grid rows and
+    # return unwritten output — refuse loudly (defaults always divide)
+    if sq % min(sq, block_q) or sk % min(sk, block_k):
+        raise ValueError(
+            f"block sizes must tile the sequence: seq {sq}/{sk} vs "
+            f"block_q={block_q}, block_k={block_k}")
     if causal and sq != sk:
         # The kernel's causal mask compares absolute row/col positions with no
         # offset, which is only meaningful for self-attention (sq == sk).

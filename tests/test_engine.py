@@ -198,3 +198,15 @@ def test_fp16_overflow_skips_step_and_backs_off_scale(devices8):
     eng2.fit(batches)
     assert int(jax.device_get(eng2.state.step)) == 5
     assert float(jax.device_get(eng2.state.scaler.loss_scale)) < 2.0 ** 125
+
+
+def test_prng_impl_rbg(devices8):
+    """Global.prng_impl switches the dropout/init PRNG family (throughput
+    option for TPU; threefry stays the default)."""
+    cfg = tiny_cfg(hidden_dropout_prob=0.1)
+    cfg["Global"]["prng_impl"] = "rbg"
+    mesh = build_mesh({}, devices=devices8[:1])
+    eng = build_engine(cfg, mesh)
+    eng.max_steps = 2
+    losses = eng.fit(make_batches(2))
+    assert len(losses) == 2 and all(np.isfinite(losses)), losses
