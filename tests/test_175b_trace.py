@@ -1,44 +1,80 @@
-"""The flagship 175B mp8 x pp16 recipe must trace end-to-end.
+"""Every flagship distributed recipe must trace end-to-end.
 
-The reference ships ``pretrain_gpt_175B_mp8_pp16.yaml`` with no way to check
-it short of a 128-GPU cluster. Here the whole step — 96-layer / 12288-hidden
-model build, logical shardings, interleaved pp16 pipeline, mp8 tensor
-sharding, forward loss AND backward — is abstractly traced (``jax.eval_shape``,
-no arrays materialised) on a 128-virtual-device CPU mesh, and the abstract
-parameter tree is asserted to actually hold ~175B parameters. This catches
-config/architecture/sharding wiring errors without hardware.
+The reference ships its biggest configs (175B mp8 x pp16, 6.7B sharding16)
+with no way to check them short of a GPU cluster. Here each recipe's whole
+step — model build at full size, logical shardings, pipeline/ring/MoE paths,
+forward loss AND backward — is abstractly traced (``jax.eval_shape``, no
+arrays materialised) on a virtual CPU mesh of the recipe's true shape, and
+the abstract parameter count is asserted. This catches config/architecture/
+sharding wiring errors without hardware.
 
-Runs in a subprocess because the device count (128) differs from the
+Runs in a subprocess because the device counts (up to 128) differ from the
 suite-wide 8-device conftest setting.
 """
 
+import json
 import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.join(os.path.dirname(__file__), "..")
 
+# (yaml, devices, micro-batch for the trace, parameter-count bounds,
+#  advertised parallel degrees — asserted so a silent yaml edit can't
+#  change the recipe's layout while the test stays green)
+RECIPES = {
+    "175B_mp8_pp16": (
+        "fleetx_tpu/configs/nlp/gpt/pretrain_gpt_175B_mp8_pp16.yaml",
+        128, 16, (1.70e11, 1.82e11),    # GPT-3 175B
+        {"mp_degree": 8, "pp_degree": 16}),
+    "6.7B_sharding16": (
+        "fleetx_tpu/configs/nlp/gpt/pretrain_gpt_6.7B_sharding16.yaml",
+        16, 8, (6.4e9, 7.2e9),
+        {"fsdp_degree": 16}),
+    "1.3B_seq8k_ring": (
+        "fleetx_tpu/configs/nlp/gpt/pretrain_gpt_1.3B_seq8k_ring.yaml",
+        8, 8, (1.2e9, 1.5e9),
+        {"dp_degree": 2, "seq_degree": 4}),
+    "moe_8expert_mp4": (
+        "fleetx_tpu/configs/nlp/gpt/pretrain_gpt_moe_8expert_mp4.yaml",
+        8, 8, (1.6e9, 1.9e9),   # 0.35B dense + 8 expert FFNs x 24 layers
+        {"dp_degree": 2, "mp_degree": 4}),
+}
+
 _CHILD = r"""
+import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-devices = jax.devices()
-assert len(devices) == 128, len(devices)
+import json
+yaml_path, n_devices, batch, lo, hi = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+    float(sys.argv[4]), float(sys.argv[5]))
+expect_degrees = json.loads(sys.argv[6])
+
+devices = jax.devices()[:n_devices]
+assert len(devices) == n_devices, (len(jax.devices()), n_devices)
+
+import flax.linen as nn
+from flax.core import meta
 
 from fleetx_tpu.core.module import GPTModule
 from fleetx_tpu.parallel.mesh import build_mesh
+from fleetx_tpu.parallel.sharding import make_axis_rules
 from fleetx_tpu.utils.config import parse_config
 
-cfg = parse_config("fleetx_tpu/configs/nlp/gpt/pretrain_gpt_175B_mp8_pp16.yaml")
+cfg = parse_config(yaml_path)
 dist = cfg["Distributed"]
-assert dist["mp_degree"] == 8 and dist["pp_degree"] == 16
+for k, v in expect_degrees.items():
+    assert int(dist.get(k) or 1) == v, (k, dist.get(k), v)
 mesh = build_mesh(dist, devices=devices)
 module = GPTModule(cfg)
 
-batch = 16  # micro-batch for the trace; the full 1536 global batch is engine-side
 seq = int(cfg["Model"].get("max_position_embeddings", 1024))
-# the batch is real (a few KB) — only the 175B parameter tree stays abstract
+# the batch is real (a few MB at most) — only the params stay abstract
 abstract_batch = {
     "tokens": np.zeros((batch, seq), np.int32),
     "position_ids": np.broadcast_to(np.arange(seq, dtype=np.int32),
@@ -47,19 +83,13 @@ abstract_batch = {
     "loss_mask": np.ones((batch, seq), np.float32),
 }
 
-import flax.linen as nn
-from flax.core import meta
-
-from fleetx_tpu.parallel.sharding import make_axis_rules
-
 rng = jax.random.PRNGKey(0)
 with mesh, nn.logical_axis_rules(make_axis_rules(dist)):
     abstract_params = jax.eval_shape(
         lambda r: module.init_variables(r, abstract_batch), rng)
     n_params = sum(int(np.prod(x.shape))
                    for x in jax.tree.leaves(meta.unbox(abstract_params)))
-    # GPT-3 175B: 96 x 12288 x 96 heads -> ~1.75e11 params
-    assert 1.70e11 < n_params < 1.82e11, n_params
+    assert lo < n_params < hi, n_params
 
     def loss_of(p):
         loss, _ = module.training_loss(p, abstract_batch, rng, jnp.int32(0))
@@ -72,16 +102,20 @@ with mesh, nn.logical_axis_rules(make_axis_rules(dist)):
                   for x in jax.tree.leaves(meta.unbox(grads)))
     assert n_grads == n_params, (n_grads, n_params)
 
-print(f"traced 175B step: params={n_params/1e9:.1f}B fwd+bwd ok")
+print(f"traced step: params={n_params/1e9:.1f}B fwd+bwd ok")
 """
 
 
-def test_175b_mp8_pp16_traces():
+@pytest.mark.parametrize("recipe", sorted(RECIPES), ids=sorted(RECIPES))
+def test_flagship_recipe_traces(recipe):
+    yaml_path, n_devices, batch, (lo, hi), degrees = RECIPES[recipe]
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.abspath(_REPO)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=128"
-    proc = subprocess.run([sys.executable, "-c", _CHILD], cwd=_REPO, env=env,
-                          capture_output=True, text=True, timeout=880)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, yaml_path, str(n_devices), str(batch),
+         str(lo), str(hi), json.dumps(degrees)], cwd=_REPO, env=env,
+        capture_output=True, text=True, timeout=880)
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert "traced 175B step" in proc.stdout, proc.stdout
+    assert "traced step" in proc.stdout, proc.stdout
