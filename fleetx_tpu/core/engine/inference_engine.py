@@ -4,8 +4,11 @@ Reference: ``ppfleetx/core/engine/inference_engine.py:73-197`` loads a
 per-rank exported static program, wires an NCCL ring for mp>1, and runs a
 predictor handle-by-handle. The TPU equivalent is radically smaller: the
 exported artifact is a serialized StableHLO module (``utils/export.py``)
-that XLA AOT-compiles once at load; tensor-parallel inference needs no ring
-CSV because the module runs under whatever mesh the caller provides.
+that XLA AOT-compiles once at load. Data-parallel serving (the reference's
+``inference_gpt_345M_dp8`` recipe) needs no launch rendezvous: the
+single-device module is ``shard_map``-ped over the mesh's batch axes, each
+device running its own batch shard — the exported per-call batch size times
+the dp degree is the served batch.
 """
 
 from __future__ import annotations
@@ -14,23 +17,103 @@ from typing import Any, Sequence
 
 import jax
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from fleetx_tpu.utils.export import load_exported
 from fleetx_tpu.utils.log import logger
 
 
-class InferenceEngine:
-    """Runs an exported model directory (reference ``predict``, l.178-197)."""
+def serving_mesh(dist_cfg: dict | None):
+    """Mesh for data-parallel serving, or None for the single-device path.
 
-    def __init__(self, model_dir: str):
+    Gates on the full batch-axis product (``dp_degree`` x ``fsdp/sharding``),
+    matching the axes ``InferenceEngine`` shards over. Shared by
+    ``tools/inference.py`` and ``tasks/gpt/inference.py``.
+    """
+    dist = dict(dist_cfg or {})
+    dp = int(dist.get("dp_degree") or 1)
+    fsdp = int(dist.get("fsdp_degree")
+               or (dist.get("sharding") or {}).get("sharding_degree") or 1)
+    if dp * fsdp <= 1:
+        return None
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(dist)
+
+
+class InferenceEngine:
+    """Runs an exported model directory (reference ``predict``, l.178-197).
+
+    ``mesh``: optional ``jax.sharding.Mesh``; when its ``data``/``fsdp``
+    axes multiply beyond 1 the engine serves data-parallel as above.
+    """
+
+    def __init__(self, model_dir: str, mesh=None):
         self.model_dir = model_dir
         self.exported, self.params = load_exported(model_dir)
-        self._call = jax.jit(self.exported.call)
-        logger.info("loaded exported model from %s", model_dir)
+        self.mesh = mesh
+        self._batch_axes = tuple(
+            a for a in ("data", "fsdp")
+            if mesh is not None and mesh.shape.get(a, 1) > 1)
+        self.dp = 1
+        for a in self._batch_axes:
+            self.dp *= mesh.shape[a]
+        self._plain_call = jax.jit(self.exported.call)
+        self._sharded_calls: dict = {}  # in_specs signature → jitted shard_map
+        logger.info("loaded exported model from %s (dp=%d)",
+                    model_dir, self.dp)
+
+    def _spec_for(self, arr: np.ndarray, pos: int) -> P:
+        """Batch-carrying inputs (rank >= 2) shard over the batch axes; rank
+        0/1 inputs (rng seeds, scalars) replicate. A rank >= 2 input whose
+        leading dim does not divide dp is an error, not a silent replicate —
+        replication would gather dp duplicated copies."""
+        if arr.ndim >= 2:
+            if arr.shape[0] % self.dp:
+                raise ValueError(
+                    f"input {pos}: leading dim {arr.shape[0]} not divisible "
+                    f"by dp={self.dp}; dp serving expects "
+                    f"exported_batch * dp rows (build the engine without a "
+                    f"mesh for single-device calls)")
+            return P(self._batch_axes)
+        return P()
 
     def predict(self, inputs: Sequence[Any]) -> list[np.ndarray]:
-        """numpy in → numpy out (reference keeps the same contract)."""
+        """numpy in → numpy out (reference keeps the same contract).
+
+        Under a dp mesh, batch-carrying inputs must have a leading dim of
+        ``exported_batch * dp``; outputs with rank >= 2 come back gathered
+        along the batch dim, rank 0/1 outputs are taken from one shard.
+        """
         arrays = [np.asarray(x) for x in inputs]
-        out = self._call(self.params, *arrays)
+        if self.dp > 1:
+            in_specs = (P(),) + tuple(self._spec_for(a, i)
+                                      for i, a in enumerate(arrays))
+            fn = self._sharded_calls.get(in_specs)
+            if fn is None:
+                call = self.exported.call
+                # out_specs must mirror the output tree: gather rank >= 2
+                # leaves over the batch axes, replicate scalars/vectors.
+                # eval_shape sees PER-SHARD inputs (the exported module's
+                # own avals), not the gathered batch
+                shard_avals = [
+                    jax.ShapeDtypeStruct(
+                        (a.shape[0] // self.dp,) + a.shape[1:], a.dtype)
+                    if spec != P() else
+                    jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a, spec in zip(arrays, in_specs[1:])]
+                out_tree = jax.eval_shape(call, self.params, *shard_avals)
+                out_specs = jax.tree.map(
+                    lambda a: P(self._batch_axes) if a.ndim >= 2 else P(),
+                    out_tree)
+                fn = jax.jit(jax.shard_map(
+                    lambda params, *args: call(params, *args),
+                    mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False))
+                self._sharded_calls[in_specs] = fn
+            with self.mesh:
+                out = fn(self.params, *arrays)
+        else:
+            out = self._plain_call(self.params, *arrays)
         leaves = jax.tree.leaves(out)
         return [np.asarray(jax.device_get(l)) for l in leaves]

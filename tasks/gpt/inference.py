@@ -8,7 +8,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import numpy as np
 
-from fleetx_tpu.core.engine.inference_engine import InferenceEngine
+from fleetx_tpu.core.engine.inference_engine import (InferenceEngine,
+                                                     serving_mesh)
 from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
 from fleetx_tpu.models.gpt.generation import left_pad
 from fleetx_tpu.utils import config as config_mod
@@ -21,7 +22,8 @@ def main():
     inf = dict(cfg.get("Inference") or {})
     gen = dict(cfg.get("Generation") or {})
 
-    engine = InferenceEngine(inf.get("model_dir", "./exported"))
+    mesh = serving_mesh(cfg.get("Distributed"))
+    engine = InferenceEngine(inf.get("model_dir", "./exported"), mesh=mesh)
     tok_dir = gen.get("tokenizer_dir") or inf.get("tokenizer_dir")
     tokenizer = GPTTokenizer.from_pretrained(tok_dir) if tok_dir else None
 
@@ -29,7 +31,10 @@ def main():
     prompt_len = int(inf.get("prompt_len", 128))
     pad_id = int(gen.get("pad_token_id", 50256))
     ids = tokenizer.encode(text) if tokenizer else [0]
-    tokens, mask = left_pad([ids], pad_id, width=prompt_len)
+    # dp serving: every data shard decodes the same prompt (a real serving
+    # frontend would enqueue distinct prompts per shard)
+    tokens, mask = left_pad([ids] * max(engine.dp, 1), pad_id,
+                            width=prompt_len)
 
     seed = np.zeros((2,), np.uint32)
     out = engine.predict([tokens, mask, seed])[0]

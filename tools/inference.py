@@ -17,7 +17,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
-from fleetx_tpu.core.engine.inference_engine import InferenceEngine
+from fleetx_tpu.core.engine.inference_engine import (InferenceEngine,
+                                                     serving_mesh)
 from fleetx_tpu.utils import config as config_mod
 from fleetx_tpu.utils.log import logger
 
@@ -26,16 +27,26 @@ def main():
     args = config_mod.parse_args("fleetx_tpu inference")
     cfg = config_mod.get_config(args.config, args.override, show=True)
     inf = dict(cfg.get("Inference") or {})
-    engine = InferenceEngine(inf.get("model_dir", "./exported"))
+    # data-parallel serving (reference inference_gpt_345M_dp8.yaml): the
+    # per-call exported batch times the dp degree is the served batch
+    mesh = serving_mesh(cfg.get("Distributed"))
+    engine = InferenceEngine(inf.get("model_dir", "./exported"), mesh=mesh)
 
     # demo batch mirroring the reference's smoke loop (tools/inference.py:178)
     glb = dict(cfg.get("Global") or {})
     seq = int(inf.get("prompt_len", glb.get("max_seq_len", 128)))
-    b = int(inf.get("batch_size", 1))
+    b = int(inf.get("batch_size", 1)) * engine.dp
     tokens = np.zeros((b, seq), np.int32)
-    position_ids = np.broadcast_to(np.arange(seq, dtype=np.int32),
-                                   (b, seq)).copy()
-    outs = engine.predict([tokens, position_ids])
+    target = inf.get("target") or "generation"
+    if target == "generation":
+        # generation exports take (tokens, attention_mask, seed)
+        mask = np.ones((b, seq), np.int32)
+        seed = np.zeros((2,), np.uint32)
+        outs = engine.predict([tokens, mask, seed])
+    else:
+        position_ids = np.broadcast_to(np.arange(seq, dtype=np.int32),
+                                       (b, seq)).copy()
+        outs = engine.predict([tokens, position_ids])
     for i, o in enumerate(outs):
         logger.info("output[%d]: shape=%s dtype=%s", i, o.shape, o.dtype)
 
