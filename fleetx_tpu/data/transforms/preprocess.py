@@ -15,6 +15,8 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from fleetx_tpu.utils.log import logger
+
 try:
     from PIL import Image
 except ImportError:  # pragma: no cover
@@ -159,9 +161,46 @@ class RandomErasing:
         return img
 
 
+class ToCHWImage:
+    """Identity (reference l.281 transposes HWC → CHW). Kept so ported
+    reference yamls build, but every model here is NHWC (TPU conv layout) —
+    transposing to CHW in the loader only to transpose back on device would
+    buy nothing, so the op is a declared no-op."""
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        return img
+
+
+class ColorJitter:
+    """Random brightness/contrast/saturation jitter (reference l.295)."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4, hue: float = 0.0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        if hue:
+            logger.warning("ColorJitter hue=%s is not supported (needs HSV "
+                           "round-trips); continuing without hue jitter", hue)
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        x = img.astype(np.float32)
+        if self.brightness:
+            x = x * random.uniform(1 - self.brightness, 1 + self.brightness)
+        if self.contrast:
+            f = random.uniform(1 - self.contrast, 1 + self.contrast)
+            x = (x - x.mean()) * f + x.mean()
+        if self.saturation:
+            f = random.uniform(1 - self.saturation, 1 + self.saturation)
+            grey = x.mean(axis=-1, keepdims=True)
+            x = (x - grey) * f + grey
+        return np.clip(x, 0, 255).astype(img.dtype)
+
+
 OPS = {cls.__name__: cls for cls in
        (DecodeImage, ResizeImage, CenterCropImage, RandCropImage,
-        RandFlipImage, NormalizeImage, RandomErasing)}
+        RandFlipImage, NormalizeImage, RandomErasing, ToCHWImage,
+        ColorJitter)}
 
 
 def build_transforms(ops_cfg: Sequence[dict]):

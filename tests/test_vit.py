@@ -128,3 +128,36 @@ def test_transforms_chain():
     assert out.shape == (32, 32, 3)
     assert out.dtype == np.float32
     assert abs(out.mean()) < 5.0
+
+
+def test_reference_yaml_op_chain_with_tochw():
+    """The reference ViT recipe's exact op chain — ColorJitter + ToCHWImage
+    included — must build and feed the module. ToCHWImage is a declared
+    no-op (every model here is NHWC), so the batch stays channels-last."""
+    from fleetx_tpu.data.transforms.preprocess import build_transforms
+
+    chain = build_transforms([
+        {"ResizeImage": {"resize_short": 40}},
+        {"RandCropImage": {"size": 32}},
+        {"ColorJitter": {}},
+        {"NormalizeImage": {}},
+        {"ToCHWImage": None},
+    ])
+    img = (np.random.RandomState(0).rand(50, 60, 3) * 255).astype(np.uint8)
+    out = chain(img)
+    assert out.shape == (32, 32, 3)
+
+    cfg = {
+        "Model": {"module": "GeneralClsModule", "name": "ViT",
+                  "num_classes": 10, "image_size": 32,
+                  "model": dict(image_size=32, patch_size=8, hidden_size=64,
+                                num_layers=2, num_attention_heads=4,
+                                dtype="float32", param_dtype="float32")},
+        "Global": {"seed": 0},
+    }
+    module = GeneralClsModule(cfg)
+    batch = {"images": np.stack([out] * 2),
+             "labels": np.asarray([1, 2], np.int32)}
+    params = module.init_variables(jax.random.PRNGKey(0), batch)
+    loss, _ = module.training_loss(params, batch, jax.random.PRNGKey(1), 0)
+    assert np.isfinite(float(loss))
