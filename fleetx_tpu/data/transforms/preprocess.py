@@ -184,6 +184,12 @@ class ColorJitter:
                            "round-trips); continuing without hue jitter", hue)
 
     def __call__(self, img: np.ndarray) -> np.ndarray:
+        if np.issubdtype(img.dtype, np.floating) and abs(img).max() <= 4.0:
+            # mean/std-normalized input: the uint8-range clip below would
+            # zero every below-mean pixel — fail fast on a misordered chain
+            raise ValueError(
+                "ColorJitter expects uint8-range images; place it before "
+                "NormalizeImage in transform_ops")
         x = img.astype(np.float32)
         if self.brightness:
             x = x * random.uniform(1 - self.brightness, 1 + self.brightness)

@@ -27,6 +27,16 @@ TINY = [
 ]
 
 
+# harness flags shared by the model-family train smokes (model-shape
+# overrides differ per family and stay inline)
+TINY_RUN = [
+    "-o", "Engine.max_steps=2", "-o", "Engine.logging_freq=1",
+    "-o", "Engine.eval_freq=0", "-o", "Engine.save_load.save_steps=0",
+    "-o", "Global.global_batch_size=16", "-o", "Global.local_batch_size=2",
+    "-o", "Global.micro_batch_size=2", "-o", "Distributed.dp_degree=8",
+]
+
+
 def _run(args, timeout=420):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
@@ -100,3 +110,43 @@ def test_raw_corpus_to_training_end_to_end(tmp_path):
     # loss still starts near ln(512) (untrained uniform predictions)
     assert len(losses) >= 2 and all(np.isfinite(losses)), losses
     assert abs(losses[0] - 6.24) < 0.8, losses
+
+
+def test_train_cli_imagen_synthetic():
+    proc = _run(["tools/train.py", "-c",
+                 "fleetx_tpu/configs/multimodal/imagen/imagen_397M_text2im_64x64.yaml",
+                 "-o", "Data.Train.dataset.name=SyntheticImagenDataset",
+                 "-o", "Data.Train.dataset.num_samples=64",
+                 "-o", "Data.Train.dataset.text_embed_dim=32",
+                 "-o", "Model.text_embed_dim=32",
+                 "-o", "Model.image_size=16",
+                 "-o", "Data.Train.dataset.image_size=16",
+                 "-o", "Model.dim=16", "-o", "Model.cond_dim=32",
+                 "-o", "Model.dtype=float32"] + TINY_RUN)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    losses = _losses(proc.stderr + proc.stdout)
+    # eps-prediction MSE on unit-normal noise starts near 1.0
+    assert losses and 0.3 < losses[0] < 3.0, losses
+
+
+def test_train_cli_vit_synthetic():
+    proc = _run(["tools/train.py", "-c",
+                 "fleetx_tpu/configs/vis/vit/ViT_base_patch16_224_pretrain.yaml",
+                 "-o", "Data.Train.dataset.name=SyntheticVisionDataset",
+                 "-o", "Data.Train.dataset.num_samples=64",
+                 "-o", "Data.Train.dataset.image_size=32",
+                 # the dataset must label within the model's class range —
+                 # out-of-range labels one-hot to all-zeros and the loss
+                 # silently collapses to the smoothing term
+                 "-o", "Data.Train.dataset.num_classes=10",
+                 "-o", "Model.image_size=32", "-o", "Model.num_classes=10",
+                 "-o", "Model.model.image_size=32",
+                 "-o", "Model.model.patch_size=8",
+                 "-o", "Model.model.hidden_size=64",
+                 "-o", "Model.model.num_layers=2",
+                 "-o", "Model.model.num_attention_heads=4",
+                 "-o", "Model.model.dtype=float32"] + TINY_RUN)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    losses = _losses(proc.stderr + proc.stdout)
+    # untrained uniform over 10 classes: ln(10)
+    assert losses and abs(losses[0] - 2.3) < 0.7, losses

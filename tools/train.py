@@ -67,7 +67,8 @@ def main():
         consumed_samples=consumed,  # global-sample units, same as the sampler
         batch_size=per_host_bs, **shape_kwargs)
     valid_dl = None
-    if (data_cfg.get("Eval") or {}).get("dataset"):
+    # eval_freq 0 disables evaluation — don't build (or require) eval data
+    if engine.eval_freq and (data_cfg.get("Eval") or {}).get("dataset"):
         valid_dl = build_dataloader(
             data_cfg, "Eval", num_replicas=n_proc, rank=jax.process_index(),
             batch_size=per_host_bs, **shape_kwargs)
