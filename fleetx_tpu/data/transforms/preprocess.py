@@ -184,12 +184,6 @@ class ColorJitter:
                            "round-trips); continuing without hue jitter", hue)
 
     def __call__(self, img: np.ndarray) -> np.ndarray:
-        if np.issubdtype(img.dtype, np.floating) and abs(img).max() <= 4.0:
-            # mean/std-normalized input: the uint8-range clip below would
-            # zero every below-mean pixel — fail fast on a misordered chain
-            raise ValueError(
-                "ColorJitter expects uint8-range images; place it before "
-                "NormalizeImage in transform_ops")
         x = img.astype(np.float32)
         if self.brightness:
             x = x * random.uniform(1 - self.brightness, 1 + self.brightness)
@@ -212,12 +206,21 @@ OPS = {cls.__name__: cls for cls in
 def build_transforms(ops_cfg: Sequence[dict]):
     """[{OpName: {kwargs}}] → composed callable (reference ``transforms/utils.py``)."""
     ops = []
+    names = []
     for item in ops_cfg or []:
         if isinstance(item, str):
             name, kwargs = item, {}
         else:
             (name, kwargs), = item.items()
+        names.append(name)
         ops.append(OPS[name](**(kwargs or {})))
+    if "ColorJitter" in names and "NormalizeImage" in names and \
+            names.index("ColorJitter") > names.index("NormalizeImage"):
+        # the jitter clips to [0, 255]; after mean/std normalization that
+        # would silently zero every below-mean pixel — op order is static,
+        # so reject the misordered chain at build time
+        raise ValueError("ColorJitter must come before NormalizeImage in "
+                         "transform_ops")
 
     def apply(x: Any) -> Any:
         for op in ops:

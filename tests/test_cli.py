@@ -15,26 +15,24 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-TINY = [
-    "-o", "Engine.max_steps=2", "-o", "Engine.logging_freq=1",
-    "-o", "Engine.eval_freq=0", "-o", "Engine.save_load.save_steps=0",
-    "-o", "Model.num_layers=2", "-o", "Model.hidden_size=64",
-    "-o", "Model.num_attention_heads=4", "-o", "Model.vocab_size=512",
-    "-o", "Model.dtype=float32", "-o", "Model.max_position_embeddings=64",
-    "-o", "Global.max_seq_len=64", "-o", "Global.global_batch_size=16",
-    "-o", "Global.local_batch_size=2", "-o", "Global.micro_batch_size=2",
-    "-o", "Distributed.dp_degree=8",
-]
-
-
-# harness flags shared by the model-family train smokes (model-shape
-# overrides differ per family and stay inline)
+# harness flags shared by every train smoke (overrides are last-wins, so
+# tests append their own -o flags to specialize)
 TINY_RUN = [
     "-o", "Engine.max_steps=2", "-o", "Engine.logging_freq=1",
     "-o", "Engine.eval_freq=0", "-o", "Engine.save_load.save_steps=0",
     "-o", "Global.global_batch_size=16", "-o", "Global.local_batch_size=2",
     "-o", "Global.micro_batch_size=2", "-o", "Distributed.dp_degree=8",
 ]
+
+# tiny GPT shape on top of the shared harness flags
+GPT_SHAPES = [
+    "-o", "Model.num_layers=2", "-o", "Model.hidden_size=64",
+    "-o", "Model.num_attention_heads=4", "-o", "Model.vocab_size=512",
+    "-o", "Model.dtype=float32", "-o", "Model.max_position_embeddings=64",
+    "-o", "Global.max_seq_len=64",
+]
+
+TINY = TINY_RUN + GPT_SHAPES
 
 
 def _run(args, timeout=420):
@@ -150,3 +148,44 @@ def test_train_cli_vit_synthetic():
     losses = _losses(proc.stderr + proc.stdout)
     # untrained uniform over 10 classes: ln(10)
     assert losses and abs(losses[0] - 2.3) < 0.7, losses
+
+
+def test_train_eval_generate_cli_round_trip(tmp_path):
+    """The user journey across three CLIs: train (writes checkpoints) →
+    offline eval (PPL from the checkpoint) → generation task (continuation
+    from the checkpoint) — all on one tiny trained model."""
+    from fleetx_tpu.data.tokenizers.gpt_tokenizer import train_bpe
+
+    tok_dir = str(tmp_path / "tok")
+    texts = ["the quick brown fox jumps over the lazy dog",
+             "pack my box with five dozen liquor jugs"] * 10
+    train_bpe(texts, vocab_size=400).save_pretrained(tok_dir)
+    eval_path = tmp_path / "wiki.txt"
+    eval_path.write_text(" ".join(texts[:6]) + "\n")
+
+    out_dir = str(tmp_path / "output")
+    shapes = GPT_SHAPES
+    proc = _run(["tools/train.py", "-c",
+                 "fleetx_tpu/configs/nlp/gpt/pretrain_gpt_345M_synthetic.yaml"]
+                + TINY_RUN + shapes
+                + ["-o", "Engine.save_load.save_steps=2",
+                   "-o", f"Engine.save_load.output_dir={out_dir}"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    proc = _run(["tools/eval.py", "-c",
+                 "fleetx_tpu/configs/nlp/gpt/eval_gpt_345M_single_card.yaml",
+                 "-o", f"Offline_Eval.tokenizer_dir={tok_dir}",
+                 "-o", f"Offline_Eval.eval_path={eval_path}",
+                 "-o", "Offline_Eval.batch_size=2"] + TINY_RUN + shapes
+                + ["-o", f"Engine.save_load.ckpt_dir={out_dir}"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    text = proc.stdout + proc.stderr
+    assert "ppl" in text.lower(), text[-800:]
+
+    proc = _run(["tasks/gpt/generation.py", "-c",
+                 "fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_single_card.yaml",
+                 "-o", f"Generation.tokenizer_dir={tok_dir}",
+                 "-o", "Generation.input_text=the quick brown",
+                 "-o", "Generation.max_dec_len=8"] + TINY_RUN + shapes
+                + ["-o", f"Engine.save_load.ckpt_dir={out_dir}"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
