@@ -15,7 +15,7 @@ from fleetx_tpu.data.dataset.gpt_dataset import (
 from fleetx_tpu.data.dataset.multimodal_dataset import (
     ImagenDataset, SyntheticImagenDataset)
 from fleetx_tpu.data.dataset.vision_dataset import (
-    CIFAR10, GeneralClsDataset, SyntheticVisionDataset)
+    CIFAR10, GeneralClsDataset, ImageFolder, SyntheticVisionDataset)
 from fleetx_tpu.data.sampler.batch_sampler import (
     DistributedBatchSampler, GPTBatchSampler)
 
@@ -25,6 +25,7 @@ DATASETS = {"GPTDataset": GPTDataset,
             "ErnieDataset": ErnieDataset,
             "SyntheticErnieDataset": SyntheticErnieDataset,
             "GeneralClsDataset": GeneralClsDataset,
+            "ImageFolder": ImageFolder,
             "CIFAR10": CIFAR10,
             "SyntheticVisionDataset": SyntheticVisionDataset,
             "ImagenDataset": ImagenDataset,

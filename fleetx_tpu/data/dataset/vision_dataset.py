@@ -14,6 +14,10 @@ import numpy as np
 
 from fleetx_tpu.data.transforms.preprocess import build_transforms
 
+DEFAULT_TRANSFORM_OPS = [{"DecodeImage": {}},
+                         {"ResizeImage": {"size": 224}},
+                         {"NormalizeImage": {}}]
+
 
 class GeneralClsDataset:
     """ImageNet-style ``<root>/<list_file>`` with ``path label`` lines
@@ -22,10 +26,8 @@ class GeneralClsDataset:
     def __init__(self, image_root: str, cls_label_path: str, transform_ops=None,
                  delimiter: str = " "):
         self.root = image_root
-        self.transform = build_transforms(
-            transform_ops or [{"DecodeImage": {}},
-                              {"ResizeImage": {"size": 224}},
-                              {"NormalizeImage": {}}])
+        self.transform = build_transforms(transform_ops
+                                          or DEFAULT_TRANSFORM_OPS)
         self.samples: list[tuple[str, int]] = []
         with open(cls_label_path) as f:
             for line in f:
@@ -42,6 +44,41 @@ class GeneralClsDataset:
         path, label = self.samples[i]
         img = self.transform(os.path.join(self.root, path))
         return {"images": np.asarray(img, np.float32), "labels": np.int32(label)}
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+class ImageFolder:
+    """``root/<class>/**/<image>`` directory-tree dataset (reference
+    ``ImageFolder``, ``vision_dataset.py:105``): class names are the sorted
+    first-level directory names; images found recursively."""
+
+    def __init__(self, root: str, transform_ops=None):
+        self.root = root
+        self.transform = build_transforms(transform_ops
+                                          or DEFAULT_TRANSFORM_OPS)
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples: list[tuple[str, int]] = []
+        for cls in self.classes:
+            for dirpath, _, files in sorted(os.walk(os.path.join(root, cls))):
+                for name in sorted(files):
+                    if name.lower().endswith(IMG_EXTENSIONS):
+                        self.samples.append(
+                            (os.path.join(dirpath, name),
+                             self.class_to_idx[cls]))
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, i: int) -> dict:
+        path, label = self.samples[i]
+        return {"images": np.asarray(self.transform(path), np.float32),
+                "labels": np.int32(label)}
 
 
 class CIFAR10:

@@ -215,7 +215,8 @@ def build_transforms(ops_cfg: Sequence[dict]):
         names.append(name)
         ops.append(OPS[name](**(kwargs or {})))
     if "ColorJitter" in names and "NormalizeImage" in names and \
-            names.index("ColorJitter") > names.index("NormalizeImage"):
+            max(i for i, n in enumerate(names) if n == "ColorJitter") > \
+            min(i for i, n in enumerate(names) if n == "NormalizeImage"):
         # the jitter clips to [0, 255]; after mean/std normalization that
         # would silently zero every below-mean pixel — op order is static,
         # so reject the misordered chain at build time

@@ -164,28 +164,33 @@ def test_train_eval_generate_cli_round_trip(tmp_path):
     eval_path.write_text(" ".join(texts[:6]) + "\n")
 
     out_dir = str(tmp_path / "output")
-    shapes = GPT_SHAPES
     proc = _run(["tools/train.py", "-c",
                  "fleetx_tpu/configs/nlp/gpt/pretrain_gpt_345M_synthetic.yaml"]
-                + TINY_RUN + shapes
+                + TINY_RUN + GPT_SHAPES
                 + ["-o", "Engine.save_load.save_steps=2",
                    "-o", f"Engine.save_load.output_dir={out_dir}"])
     assert proc.returncode == 0, proc.stderr[-2000:]
+    # the save path must have produced a checkpoint — eval/generation fall
+    # back to random weights with a warning, which would mask a regression
+    assert os.path.isdir(out_dir) and os.listdir(out_dir), out_dir
 
     proc = _run(["tools/eval.py", "-c",
                  "fleetx_tpu/configs/nlp/gpt/eval_gpt_345M_single_card.yaml",
                  "-o", f"Offline_Eval.tokenizer_dir={tok_dir}",
                  "-o", f"Offline_Eval.eval_path={eval_path}",
-                 "-o", "Offline_Eval.batch_size=2"] + TINY_RUN + shapes
+                 "-o", "Offline_Eval.batch_size=2"] + TINY_RUN + GPT_SHAPES
                 + ["-o", f"Engine.save_load.ckpt_dir={out_dir}"])
     assert proc.returncode == 0, proc.stderr[-2000:]
     text = proc.stdout + proc.stderr
     assert "ppl" in text.lower(), text[-800:]
+    assert "NO CHECKPOINT" not in text, text[-800:]
 
     proc = _run(["tasks/gpt/generation.py", "-c",
                  "fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_single_card.yaml",
                  "-o", f"Generation.tokenizer_dir={tok_dir}",
                  "-o", "Generation.input_text=the quick brown",
-                 "-o", "Generation.max_dec_len=8"] + TINY_RUN + shapes
+                 "-o", "Generation.max_dec_len=8"] + TINY_RUN + GPT_SHAPES
                 + ["-o", f"Engine.save_load.ckpt_dir={out_dir}"])
     assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "no checkpoint" not in (proc.stdout + proc.stderr), \
+        (proc.stdout + proc.stderr)[-800:]
