@@ -62,6 +62,9 @@ class GPTConfig:
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
     sequence_parallel: bool = False
     use_ring_attention: bool = False  # context parallelism over the seq axis
+    # stream incoming ring K/V blocks in chunks of this many tokens to bound
+    # per-step score memory (None = whole block at once)
+    ring_kv_chunk: Optional[int] = None
     use_qat: bool = False      # int8 fake-quant on linears (ops/quantization.py)
     qat_bits: int = 8
     moe_num_experts: int = 0   # 0 = dense FFN; >0 = MoE (models/gpt/moe.py)
@@ -214,7 +217,8 @@ class MultiHeadAttention(nn.Module):
 
             assert cfg.attention_probs_dropout_prob == 0.0 or deterministic, \
                 "ring attention does not support attention dropout"
-            fn = partial(ra.ring_attention, causal=True)
+            fn = partial(ra.ring_attention, causal=True,
+                         kv_chunk=cfg.ring_kv_chunk)
         elif cfg.use_flash_attention:
             from fleetx_tpu.ops import flash_attention
             rate = 0.0 if deterministic else cfg.attention_probs_dropout_prob

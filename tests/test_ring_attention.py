@@ -109,3 +109,47 @@ def test_engine_loss_parity_ring_seq_parallel(devices8):
     mesh = build_mesh(cfg["Distributed"], devices=devices8)
     got = _run(cfg, mesh)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("chunk", [4, 8])
+def test_ring_kv_chunk_streaming_matches_unchunked(devices8, chunk):
+    """Chunked K/V streaming (bounded score memory for long context) is the
+    exact same math — values AND gradients."""
+    rng = np.random.RandomState(2)
+    b, s, n, d = 2, 64, 2, 8
+    q = jnp.asarray(rng.randn(b, s, n, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, n, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, n, d), jnp.float32)
+    want = fa.reference_attention(q, k, v, causal=True)
+
+    mesh = build_mesh({"seq_degree": 4}, devices=devices8[:4])
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, causal=True, kv_chunk=chunk))(q, k, v)
+
+        def loss_chunked(q, k, v):
+            return (ring_attention(q, k, v, causal=True,
+                                   kv_chunk=chunk) ** 2).sum()
+
+        grads = jax.jit(jax.grad(loss_chunked, argnums=(0, 1, 2)))(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_ref(q, k, v):
+        return (fa.reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    want_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(grads, want_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ring_kv_chunk_must_divide_block(devices8):
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32)
+    mesh = build_mesh({"seq_degree": 4}, devices=devices8[:4])
+    with mesh:
+        with pytest.raises(ValueError, match="must divide"):
+            jax.jit(lambda q: ring_attention(q, q, q, causal=True,
+                                             kv_chunk=3))(x)
