@@ -191,15 +191,29 @@ class GPTModule(LanguageModule):
         dropout_rng = jax.random.fold_in(rng, step)
         variables = {"params": meta.unbox(params)}
         if self.model_cfg.moe_num_experts > 0:
-            logits, aux_vars = self.model.apply(
+            kwargs = {}
+            if self.model_cfg.vocab_chunk:
+                # the chunked LM head composes with the MoE aux collection
+                kwargs = dict(labels=batch["labels"],
+                              loss_mask=batch["loss_mask"])
+            out, aux_vars = self.model.apply(
                 variables, batch["tokens"], batch["position_ids"],
                 deterministic=False, rngs={"dropout": dropout_rng},
-                mutable=["losses"])
-            loss = cross_entropy_loss(logits, batch["labels"],
-                                      batch["loss_mask"])
+                mutable=["losses"], **kwargs)
+            loss = (out if self.model_cfg.vocab_chunk else
+                    cross_entropy_loss(out, batch["labels"],
+                                       batch["loss_mask"]))
             aux = sum(jnp.sum(l) for l in
                       jax.tree.leaves(aux_vars.get("losses", {})))
             return loss + aux, {"loss": loss, "moe_aux": aux}
+        if self.model_cfg.vocab_chunk:
+            # memory-efficient LM head: the model computes the masked loss
+            # itself, never materialising [b, s, vocab] logits
+            loss = self.model.apply(
+                variables, batch["tokens"], batch["position_ids"],
+                deterministic=False, rngs={"dropout": dropout_rng},
+                labels=batch["labels"], loss_mask=batch["loss_mask"])
+            return loss, {"loss": loss}
         logits = self.model.apply(
             variables, batch["tokens"], batch["position_ids"],
             deterministic=False, rngs={"dropout": dropout_rng})
@@ -210,8 +224,15 @@ class GPTModule(LanguageModule):
         from flax.core import meta
         from fleetx_tpu.models.gpt.model import cross_entropy_loss
 
+        variables = {"params": meta.unbox(params)}
+        if self.model_cfg.vocab_chunk:
+            loss = self.model.apply(
+                variables, batch["tokens"], batch["position_ids"],
+                deterministic=True, labels=batch["labels"],
+                loss_mask=batch["loss_mask"])
+            return loss, {"loss": loss}
         logits = self.model.apply(
-            {"params": meta.unbox(params)}, batch["tokens"], batch["position_ids"],
+            variables, batch["tokens"], batch["position_ids"],
             deterministic=True)
         loss = cross_entropy_loss(logits, batch["labels"], batch["loss_mask"])
         return loss, {"loss": loss}

@@ -107,3 +107,53 @@ def test_param_count_345m():
         lambda: m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))
     n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
     assert 340e6 < n < 420e6  # ~355M with 50304 vocab
+
+
+def test_chunked_lm_head_matches_full_logits_loss():
+    """vocab_chunk computes the identical masked loss and parameter
+    gradients without materialising [b, s, V] logits."""
+    from flax.core import meta
+
+    from fleetx_tpu.models.gpt.model import (GPTForPretraining,
+                                             config_from_dict,
+                                             cross_entropy_loss)
+
+    base = dict(vocab_size=100, hidden_size=32, num_layers=2,
+                num_attention_heads=4, max_position_embeddings=16,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                use_flash_attention=False, dtype="float32",
+                param_dtype="float32")
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 100, (2, 16)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+    labels = jnp.asarray(rng.randint(0, 100, (2, 16)), jnp.int32)
+    mask = jnp.asarray(rng.rand(2, 16) > 0.2, jnp.float32)
+
+    full = GPTForPretraining(config_from_dict(base))
+    params = meta.unbox(full.init({"params": jax.random.PRNGKey(0)},
+                                  tokens, pos, deterministic=True)["params"])
+
+    def loss_full(p):
+        logits = full.apply({"params": p}, tokens, pos, deterministic=True)
+        return cross_entropy_loss(logits, labels, mask)
+
+    # chunk 48 does not divide V=100 — the padded tail must be handled
+    chunked = GPTForPretraining(config_from_dict(dict(base, vocab_chunk=48)))
+
+    def loss_chunked(p):
+        return chunked.apply({"params": p}, tokens, pos, deterministic=True,
+                             labels=labels, loss_mask=mask)
+
+    np.testing.assert_allclose(float(loss_chunked(params)),
+                               float(loss_full(params)), rtol=1e-6)
+    g_full = jax.grad(loss_full)(params)
+    g_chunk = jax.grad(loss_chunked)(params)
+    flat_full = {str(k): v for k, v in
+                 jax.tree_util.tree_flatten_with_path(g_full)[0]}
+    flat_chunk = {str(k): v for k, v in
+                  jax.tree_util.tree_flatten_with_path(g_chunk)[0]}
+    assert flat_full.keys() == flat_chunk.keys()
+    for key in flat_full:
+        np.testing.assert_allclose(np.asarray(flat_chunk[key]),
+                                   np.asarray(flat_full[key]),
+                                   rtol=2e-5, atol=2e-6, err_msg=key)

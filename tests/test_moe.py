@@ -100,3 +100,12 @@ def test_moe_loss_parity_dp_tp(devices8):
     mesh = build_mesh(cfg["Distributed"], devices=devices8)
     got = _run(cfg, mesh, list(data), 3)
     np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_moe_with_chunked_lm_head(devices8):
+    """vocab_chunk must compose with MoE (same loss as full logits + aux)."""
+    data = [_batch(seed=s) for s in range(2)]
+    mesh = build_mesh({}, devices=devices8[:1])
+    ref = _run(_cfg(), mesh, list(data), 2)
+    got = _run(_cfg(vocab_chunk=48), mesh, list(data), 2)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
