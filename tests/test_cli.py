@@ -194,3 +194,21 @@ def test_train_eval_generate_cli_round_trip(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "no checkpoint" not in (proc.stdout + proc.stderr), \
         (proc.stdout + proc.stderr)[-800:]
+
+
+def test_imagen_generate_cli(tmp_path):
+    """tasks/imagen/generate.py samples the cascade (tiny shapes, few
+    denoise steps) and writes the image tensor."""
+    out = str(tmp_path / "samples.npy")
+    proc = _run(["tasks/imagen/generate.py", "-c",
+                 "fleetx_tpu/configs/multimodal/imagen/imagen_397M_text2im_64x64.yaml",
+                 "-o", "Model.image_size=16", "-o", "Model.dim=16",
+                 "-o", "Model.cond_dim=32", "-o", "Model.text_embed_dim=32",
+                 "-o", "Model.timesteps=8", "-o", "Model.dtype=float32",
+                 "-o", "Generation.batch_size=2",
+                 "-o", f"Generation.output_path={out}"] + TINY_RUN,
+                timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    arr = np.load(out)
+    assert arr.shape == (2, 16, 16, 3), arr.shape
+    assert np.isfinite(arr).all()
