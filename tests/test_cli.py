@@ -185,6 +185,25 @@ def test_train_eval_generate_cli_round_trip(tmp_path):
     assert "ppl" in text.lower(), text[-800:]
     assert "NO CHECKPOINT" not in text, text[-800:]
 
+    # LAMBADA accuracy mode over the same checkpoint (eval_type=acc)
+    lamb = tmp_path / "lambada.jsonl"
+    with open(lamb, "w") as f:
+        import json
+        for t in texts[:4]:
+            f.write(json.dumps({"text": t}) + "\n")
+    proc = _run(["tools/eval.py", "-c",
+                 "fleetx_tpu/configs/nlp/gpt/eval_gpt_345M_single_card.yaml",
+                 "-o", "Offline_Eval.eval_type=acc",
+                 "-o", f"Offline_Eval.tokenizer_dir={tok_dir}",
+                 "-o", f"Offline_Eval.eval_path={lamb}",
+                 "-o", "Offline_Eval.batch_size=2"] + TINY_RUN + GPT_SHAPES
+                + ["-o", f"Engine.save_load.ckpt_dir={out_dir}"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    text = proc.stdout + proc.stderr
+    # the results dict printed by _offline_eval, not the config echo
+    assert "'acc':" in text, text[-800:]
+    assert "NO CHECKPOINT" not in text, text[-800:]
+
     proc = _run(["tasks/gpt/generation.py", "-c",
                  "fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_single_card.yaml",
                  "-o", f"Generation.tokenizer_dir={tok_dir}",
@@ -206,7 +225,13 @@ def test_imagen_generate_cli(tmp_path):
                  "-o", "Model.cond_dim=32", "-o", "Model.text_embed_dim=32",
                  "-o", "Model.timesteps=8", "-o", "Model.dtype=float32",
                  "-o", "Generation.batch_size=2",
-                 "-o", f"Generation.output_path={out}"] + TINY_RUN,
+                 "-o", f"Generation.output_path={out}",
+                 # the sampler ignores the train harness; these only satisfy
+                 # config validation against the 8-device test env
+                 "-o", "Distributed.dp_degree=8",
+                 "-o", "Global.global_batch_size=16",
+                 "-o", "Global.local_batch_size=2",
+                 "-o", "Global.micro_batch_size=2"],
                 timeout=600)
     assert proc.returncode == 0, proc.stderr[-2000:]
     arr = np.load(out)
