@@ -22,10 +22,18 @@ def check_version() -> bool:
 
 
 def check_devices(expect_tpu: bool = False) -> bool:
-    """Log the device inventory; warn when a TPU config runs on CPU."""
+    """Log the device inventory; warn when a TPU config runs on CPU.
+
+    A check must diagnose, not crash: backend-init failures (e.g. an
+    unreachable TPU plugin) are reported as a failed check, not raised.
+    """
     import jax
 
-    devices = jax.devices()
+    try:
+        devices = jax.devices()
+    except RuntimeError as e:
+        logger.warning("backend initialization failed: %s", e)
+        return False
     platform = devices[0].platform
     logger.info("devices: %d x %s (%s)", len(devices), platform,
                 getattr(devices[0], "device_kind", "?"))

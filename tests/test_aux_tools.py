@@ -92,3 +92,39 @@ def test_image_folder_directory_tree(tmp_path):
     labels = sorted(int(ds[i]["labels"]) for i in range(len(ds)))
     assert labels == [0, 0, 1, 1]
     assert ds[0]["images"].shape == (32, 32, 3)
+
+
+def test_cached_path_local_and_cache_hit(tmp_path, monkeypatch):
+    """download cache: local paths pass through; cached URLs resolve without
+    a network fetch; missing local files fail loudly."""
+    import pytest
+
+    from fleetx_tpu.utils import download as D
+
+    monkeypatch.setenv("FLEETX_CACHE", str(tmp_path / "cache"))
+    # local path passthrough
+    f = tmp_path / "vocab.json"
+    f.write_text("{}")
+    assert D.cached_path(str(f)) == str(f)
+    assert D.cached_path(f"file://{f}") == str(f)
+    with pytest.raises(FileNotFoundError):
+        D.cached_path(str(tmp_path / "missing.txt"))
+
+    # a pre-populated cache entry is returned without any network access
+    import hashlib
+    url = "https://example.invalid/models/merges.txt"
+    key = hashlib.md5(url.encode()).hexdigest()[:8]
+    target_dir = tmp_path / "cache" / "tok"
+    os.makedirs(target_dir)
+    (target_dir / f"{key}_merges.txt").write_text("cached")
+    got = D.cached_path(url, sub_dir="tok")
+    with open(got) as fh:
+        assert fh.read() == "cached"
+
+
+def test_startup_checks():
+    from fleetx_tpu.utils import check as C
+
+    assert C.check_version()
+    assert C.check_devices()  # cpu backend acceptable when not expecting tpu
+    assert C.check_config({"Global": {"seed": 1}, "Model": {}})

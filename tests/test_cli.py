@@ -15,14 +15,18 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# batch/topology flags consistent with the 8-virtual-device harness
+BATCH_FLAGS = [
+    "-o", "Global.global_batch_size=16", "-o", "Global.local_batch_size=2",
+    "-o", "Global.micro_batch_size=2", "-o", "Distributed.dp_degree=8",
+]
+
 # harness flags shared by every train smoke (overrides are last-wins, so
 # tests append their own -o flags to specialize)
 TINY_RUN = [
     "-o", "Engine.max_steps=2", "-o", "Engine.logging_freq=1",
     "-o", "Engine.eval_freq=0", "-o", "Engine.save_load.save_steps=0",
-    "-o", "Global.global_batch_size=16", "-o", "Global.local_batch_size=2",
-    "-o", "Global.micro_batch_size=2", "-o", "Distributed.dp_degree=8",
-]
+] + BATCH_FLAGS
 
 # tiny GPT shape on top of the shared harness flags
 GPT_SHAPES = [
@@ -226,12 +230,9 @@ def test_imagen_generate_cli(tmp_path):
                  "-o", "Model.timesteps=8", "-o", "Model.dtype=float32",
                  "-o", "Generation.batch_size=2",
                  "-o", f"Generation.output_path={out}",
-                 # the sampler ignores the train harness; these only satisfy
-                 # config validation against the 8-device test env
-                 "-o", "Distributed.dp_degree=8",
-                 "-o", "Global.global_batch_size=16",
-                 "-o", "Global.local_batch_size=2",
-                 "-o", "Global.micro_batch_size=2"],
+                 # the sampler ignores the train harness; BATCH_FLAGS only
+                 # satisfy config validation against the 8-device test env
+                 ] + BATCH_FLAGS,
                 timeout=600)
     assert proc.returncode == 0, proc.stderr[-2000:]
     arr = np.load(out)
