@@ -420,8 +420,10 @@ class GPTModel(nn.Module):
         x = GPTEmbeddings(cfg, name="embeddings")(tokens, position_ids, deterministic)
 
         layer = TransformerDecoderLayer
-        if cfg.use_recompute and cache is None and \
-                cfg.recompute_granularity in ("full", "dots"):
+        use_remat = (cfg.use_recompute and cache is None and
+                     cfg.recompute_granularity in ("full", "dots"))
+        policy = None
+        if use_remat:
             policy = (jax.checkpoint_policies.nothing_saveable
                       if cfg.recompute_granularity == "full" else
                       jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
@@ -434,8 +436,10 @@ class GPTModel(nn.Module):
         if cfg.pp_degree > 1 and cache is None:
             # pipeline-parallel stack (reference GPTForPretrainingPipe,
             # hybrid_model.py:862-962 → parallel/pipeline.py). Flash attention
-            # is a custom call GSPMD cannot partition over the vmapped stage
-            # axis, so the pipelined stack uses the XLA attention path.
+            # runs INSIDE the stages (reference fused attention in pipe,
+            # hybrid_model.py:277): the stage vmap carries
+            # spmd_axis_name="pipe", so the kernel's shard_map keeps the
+            # Mosaic call per-device with the stage dim sharded over pipe.
             from fleetx_tpu.parallel.pipeline import (
                 make_stage_stack, pipeline_apply)
 
@@ -445,10 +449,14 @@ class GPTModel(nn.Module):
             V = max(cfg.virtual_pp_degree, 1)
             chunks = cfg.pp_degree * V
             assert cfg.num_layers % chunks == 0
-            pcfg = dataclasses.replace(cfg, use_flash_attention=False)
+            # the RAW layer class goes in — the pipeline wraps it with a
+            # fixed (x)->x signature and applies remat itself (a transformed
+            # flax class cannot be re-subclassed)
             stages = make_stage_stack(
-                layer, cfg.pp_degree, cfg.num_layers // chunks,
-                num_repeats=V)(pcfg, name="layers")
+                TransformerDecoderLayer, cfg.pp_degree,
+                cfg.num_layers // chunks, num_repeats=V,
+                deterministic=deterministic, remat_policy=policy,
+                remat=use_remat)(cfg, name="layers")
             x = pipeline_apply(stages, x, cfg.pp_degree,
                                cfg.pp_microbatches or cfg.pp_degree,
                                deterministic=deterministic, num_repeats=V)

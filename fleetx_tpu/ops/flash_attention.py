@@ -327,19 +327,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
-    q3, k3, v3, seed, out, lse = residuals
-    do = g
+def _bwd_dq(q3, k3, v3, do, lse3, delta3, seed, *, scale, causal,
+            block_q, block_k, dropout_rate: float = 0.0):
+    """dq kernel entry: lse3/delta3 as ``[bn, sq, 1]`` (any lse works — the
+    ring backward feeds the GLOBAL logsumexp to get exact per-block grads)."""
     bn, sq, d = q3.shape
     sk = k3.shape[1]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
-    delta = (out.astype(jnp.float32) * do.astype(jnp.float32)).sum(axis=-1)
-    # lse/delta travel as [bn, sq, 1] so their blocks tile on TPU (see _fwd)
-    lse3 = lse[..., None]
-    delta3 = delta[..., None]
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, dropout_rate=dropout_rate),
         grid=(bn, sq // bq, sk // bk),
@@ -358,7 +354,15 @@ def _bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
         interpret=_interpret(),
     )(q3, k3, v3, do, lse3, delta3, seed)
 
-    dk, dv = pl.pallas_call(
+
+def _bwd_dkv(q3, k3, v3, do, lse3, delta3, seed, *, scale, causal,
+             block_q, block_k, dropout_rate: float = 0.0):
+    """dk/dv kernel entry (same lse3/delta3 contract as ``_bwd_dq``)."""
+    bn, sq, d = q3.shape
+    sk = k3.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, dropout_rate=dropout_rate),
         grid=(bn, sk // bk, sq // bq),
@@ -382,6 +386,19 @@ def _bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
         scratch_shapes=[_VMEM((bk, d), jnp.float32), _VMEM((bk, d), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, do, lse3, delta3, seed)
+
+
+def _bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
+    q3, k3, v3, seed, out, lse = residuals
+    do = g
+    delta = (out.astype(jnp.float32) * do.astype(jnp.float32)).sum(axis=-1)
+    # lse/delta travel as [bn, sq, 1] so their blocks tile on TPU (see _fwd)
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              dropout_rate=dropout_rate)
+    dq = _bwd_dq(q3, k3, v3, do, lse3, delta3, seed, **kw)
+    dk, dv = _bwd_dkv(q3, k3, v3, do, lse3, delta3, seed, **kw)
     return dq, dk, dv, None
 
 
@@ -500,6 +517,15 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     manual = tuple(a for a in ("data", "fsdp", "tensor")
                    if mesh.shape.get(a, 1) > 1)
+    # Under pipeline parallelism this wrapper is reached through the stage
+    # nn.vmap (``spmd_axis_name="pipe"``, parallel/pipeline.py): declaring
+    # ``pipe`` manual here lets the vmap batching rule shard the stage dim
+    # over ``pipe`` — without it, sdy refuses the composition and GSPMD
+    # would all-gather the Mosaic call's operands across stages. Outside
+    # that vmap the extra manual axis just asserts pipe-replication, which
+    # holds for every non-pipelined caller (decode, single-stack training).
+    if mesh.shape.get("pipe", 1) > 1:
+        manual = manual + ("pipe",)
     if not manual:
         return flash_attention(q, k, v, causal=causal, **kwargs)
     batch_axes = tuple(a for a in ("data", "fsdp") if a in manual)

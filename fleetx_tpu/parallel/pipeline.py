@@ -41,25 +41,54 @@ __all__ = ["make_stage_stack", "pipeline_apply"]
 
 def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
                      layers_per_stage: int,
-                     num_repeats: int = 1) -> Type[nn.Module]:
-    """Stage-stacked layer module: params ``[num_stages, layers_per_stage, ...]``
-    (or ``[num_repeats, num_stages, layers_per_stage, ...]`` for interleaved
+                     num_repeats: int = 1,
+                     deterministic: bool = True,
+                     remat_policy: Any = None,
+                     remat: bool = False) -> Any:
+    """Stage-stacked layer factory — returns ``make(cfg, name=...) → Module``
+    with params ``[num_stages, layers_per_stage, ...]`` (or
+    ``[num_repeats, num_stages, layers_per_stage, ...]`` for interleaved
     virtual stages).
 
     The inner ``nn.scan`` runs one chunk's layers sequentially (axis name
     ``layers``, same as the non-pipelined stack); ``nn.vmap`` adds the stage
-    axis (name ``pipe_stage``, sharded over ``pipe`` by the rule table) and,
-    for virtual pipelining, an outer unsharded repeat axis (``pipe_repeat``):
-    logical stage ``l = v*S + d`` lives as chunk ``[v, d]`` — the reference's
+    axis (name ``pipe_stage``, sharded over ``pipe`` by the rule table —
+    ``spmd_axis_name`` so GSPMD keeps per-stage computation, including the
+    flash-attention Mosaic kernel, on its own pipe device) and, for virtual
+    pipelining, an outer unsharded repeat axis (``pipe_repeat``): logical
+    stage ``l = v*S + d`` lives as chunk ``[v, d]`` — the reference's
     ``virtual_pp_degree`` round-robin placement (``hybrid_model.py:962``).
     Tree paths are identical to the non-pipelined stack — only the leading
     dims differ (``[L] → [V, S, L/(V*S)]``).
+
+    The layer's side args (no cache, no mask, static ``deterministic``) are
+    bound as a module field rather than passed through the transforms:
+    flax's ``spmd_axis_name`` rng-split path rejects bare-leaf broadcast
+    arguments (it prefix-matches ``in_axes`` ``None`` entries against the
+    argument tree), so the vmapped call must carry exactly one array arg.
+    For the same reason remat (``remat=True`` + ``remat_policy``) is applied
+    HERE, to the fixed-signature wrapper — a transformed flax class cannot
+    be subclassed with the extra field.
     """
+
+    class _PipeLayer(layer_cls):
+        """``layer_cls`` with the pipeline-fixed call signature ``(x) -> x``."""
+
+        pipe_deterministic: bool = True
+
+        def __call__(self, x):  # noqa: D102 — see class docstring
+            out, _ = super().__call__(x, None, self.pipe_deterministic, None)
+            return out, None  # (carry, per-layer out) for the layer scan
+
+    _PipeLayer.__name__ = getattr(layer_cls, "__name__", "PipeLayer")
+    target = _PipeLayer
+    if remat:
+        target = nn.remat(_PipeLayer, prevent_cse=False, policy=remat_policy)
+
     stage = nn.scan(
-        layer_cls,
+        target,
         variable_axes={"params": 0},
         split_rngs={"params": True, "dropout": True},
-        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
         out_axes=0,
         length=layers_per_stage,
         metadata_params={nn.PARTITION_NAME: "layers"},
@@ -68,20 +97,32 @@ def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
         stage,
         variable_axes={"params": 0},
         split_rngs={"params": True, "dropout": True},
-        in_axes=(0, None, None, None),
+        in_axes=0,
         out_axes=0,
         metadata_params={nn.PARTITION_NAME: "pipe_stage"},
+        spmd_axis_name="pipe",
     )
     if num_repeats == 1:
-        return stages
-    return nn.vmap(
+        return _with_det(stages, deterministic)
+    stages = nn.vmap(
         stages,
         variable_axes={"params": 0},
         split_rngs={"params": True, "dropout": True},
-        in_axes=(0, None, None, None),
+        in_axes=0,
         out_axes=0,
         metadata_params={nn.PARTITION_NAME: "pipe_repeat"},
     )
+    return _with_det(stages, deterministic)
+
+
+def _with_det(stack_cls: Type[nn.Module], deterministic: bool):
+    """Bind the ``pipe_deterministic`` field at construction time so callers
+    keep the ``stack(cfg, name=...)`` construction shape."""
+
+    def make(cfg, name):
+        return stack_cls(cfg, deterministic, name=name)
+
+    return make
 
 
 def _constrain(x: jnp.ndarray, axes: tuple) -> jnp.ndarray:
@@ -107,12 +148,16 @@ def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
     S, M, V = num_stages, num_microbatches, num_repeats
     batch = x.shape[0]
     if batch % M:
-        # only param-init traces (single sample) may bypass microbatching;
-        # a real batch that doesn't divide is a config error, not something
-        # to silently degrade the schedule over
-        assert batch == 1, (
-            f"batch {batch} not divisible by pp_microbatches {M}")
-        M = 1
+        # Param-init traces (single sample) and scaled-down proxy batches
+        # (e.g. tracing the 175B recipe, accumulate_steps 1536, with a
+        # 16-sample batch) keep the schedule shape with M capped at the
+        # batch size. A real batch that neither divides into nor divides M
+        # is a config error, not something to silently degrade over.
+        if batch < M and (batch == 1 or M % batch == 0):
+            M = batch
+        else:
+            raise ValueError(
+                f"batch {batch} not divisible by pp_microbatches {M}")
     mb = batch // M
     rest = x.shape[1:]
     act_axes = ("batch", "act_seq", "act_embed")
@@ -133,7 +178,7 @@ def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
         else:
             shift = shift.at[0, 0].set(x_in)
         shift = _constrain(shift, shift_axes)
-        out, _ = mod(shift, None, deterministic, None)
+        out, _ = mod(shift)  # deterministic/cache/mask bound in the stack
         out = _constrain(out, shift_axes)
         if V == 1:
             y_last = out[-1]                      # drain final logical stage

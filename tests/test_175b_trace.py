@@ -102,6 +102,39 @@ with mesh, nn.logical_axis_rules(make_axis_rules(dist)):
                   for x in jax.tree.leaves(meta.unbox(grads)))
     assert n_grads == n_params, (n_grads, n_params)
 
+    if int(dist.get("pp_degree") or 1) > 1 and \
+            bool(cfg["Model"].get("use_flash_attention", True)):
+        # flash attention must be SELECTED inside the pipeline stages
+        # (VERDICT r3 #3). In-kernel attention dropout is TPU-only, so the
+        # CPU trace checks the dropout-free selection; numerics parity is
+        # test_pipeline.py::test_pipeline_flash_attention_parity.
+        cfg2 = dict(cfg)
+        cfg2["Model"] = dict(cfg["Model"])
+        cfg2["Model"]["attention_probs_dropout_prob"] = 0.0
+        module2 = GPTModule(cfg2)
+        params2 = jax.eval_shape(
+            lambda r: module2.init_variables(r, abstract_batch), rng)
+
+        def fwd(p):
+            loss, _ = module2.training_loss(p, abstract_batch, rng,
+                                            jnp.int32(0))
+            return loss
+
+        def has_pallas(j):
+            for eqn in j.eqns:
+                if "pallas" in eqn.primitive.name:
+                    return True
+                for v in eqn.params.values():
+                    for sub in jax.tree.leaves(
+                            v, is_leaf=lambda x: hasattr(x, "eqns")):
+                        if hasattr(sub, "eqns") and has_pallas(sub):
+                            return True
+            return False
+
+        assert has_pallas(jax.make_jaxpr(fwd)(params2).jaxpr), \
+            "pipelined 175B trace did not select the flash attention path"
+        print("flash-in-pipe: ok")
+
 print(f"traced step: params={n_params/1e9:.1f}B fwd+bwd ok")
 """
 
