@@ -24,17 +24,20 @@ from fleetx_tpu.utils.log import logger
 
 
 def serving_mesh(dist_cfg: dict | None):
-    """Mesh for data-parallel serving, or None for the single-device path.
+    """Mesh for distributed serving, or None for the single-device path.
 
-    Gates on the full batch-axis product (``dp_degree`` x ``fsdp/sharding``),
-    matching the axes ``InferenceEngine`` shards over. Shared by
-    ``tools/inference.py`` and ``tasks/gpt/inference.py``.
+    Gates on the batch-axis product (``dp_degree`` x ``fsdp/sharding``) and
+    the tensor axis (``mp_degree`` — the reference's mp-sharded serving,
+    ``inference_engine.py:128-163``), matching the axes ``InferenceEngine``
+    shards over. Shared by ``tools/inference.py`` and
+    ``tasks/gpt/inference.py``.
     """
     dist = dict(dist_cfg or {})
     dp = int(dist.get("dp_degree") or 1)
     fsdp = int(dist.get("fsdp_degree")
                or (dist.get("sharding") or {}).get("sharding_degree") or 1)
-    if dp * fsdp <= 1:
+    mp = int(dist.get("mp_degree") or 1)
+    if dp * fsdp * mp <= 1:
         return None
     from fleetx_tpu.parallel.mesh import build_mesh
 
@@ -58,10 +61,37 @@ class InferenceEngine:
         self.dp = 1
         for a in self._batch_axes:
             self.dp *= mesh.shape[a]
+        self.mp = mesh.shape.get("tensor", 1) if mesh is not None else 1
+        if self.mp > 1:
+            self._init_tensor_parallel(model_dir)
         self._plain_call = jax.jit(self.exported.call)
         self._sharded_calls: dict = {}  # in_specs signature → jitted shard_map
-        logger.info("loaded exported model from %s (dp=%d)",
-                    model_dir, self.dp)
+        logger.info("loaded exported model from %s (dp=%d, mp=%d)",
+                    model_dir, self.dp, self.mp)
+
+    def _init_tensor_parallel(self, model_dir: str):
+        """Tensor-parallel serving (reference mp-sharded exports +
+        comm-ring CSV, ``inference_engine.py:128-163``): place the params
+        onto the mesh by the export's saved logical specs and let GSPMD
+        partition the (inlined) StableHLO body — one artifact serves any
+        mp degree, no per-rank files, no ring bootstrap."""
+        from flax import linen as nn
+        from jax.sharding import NamedSharding
+
+        from fleetx_tpu.parallel.sharding import make_axis_rules
+        from fleetx_tpu.utils.export import load_param_specs
+
+        specs = load_param_specs(model_dir)
+        if specs is None:
+            raise ValueError(
+                f"{model_dir} has no param_specs in meta.json — re-export "
+                f"with a current tools/export.py to serve tensor-parallel")
+        rules = make_axis_rules({})
+        self._param_shardings = jax.tree.map(
+            lambda s: NamedSharding(
+                self.mesh, nn.logical_to_mesh_axes(s, rules)),
+            specs, is_leaf=lambda x: isinstance(x, P))
+        self.params = jax.device_put(self.params, self._param_shardings)
 
     def _spec_for(self, arr: np.ndarray, pos: int) -> P:
         """Batch-carrying inputs (rank >= 2) shard over the batch axes; rank
@@ -81,12 +111,43 @@ class InferenceEngine:
     def predict(self, inputs: Sequence[Any]) -> list[np.ndarray]:
         """numpy in → numpy out (reference keeps the same contract).
 
-        Under a dp mesh, batch-carrying inputs must have a leading dim of
-        ``exported_batch * dp``; outputs with rank >= 2 come back gathered
-        along the batch dim, rank 0/1 outputs are taken from one shard.
+        Batch contract by mesh shape:
+
+        - dp-only mesh: batch-carrying inputs carry ``exported_batch * dp``
+          rows (each device runs the exported program on its shard);
+        - mp mesh (with or without dp): inputs match the EXPORTED batch
+          exactly — GSPMD partitions the one traced program, splitting the
+          batch dim across any dp axes and the weights across ``tensor``.
+
+        Outputs with rank >= 2 come back gathered along the batch dim,
+        rank 0/1 outputs are taken from one shard.
         """
         arrays = [np.asarray(x) for x in inputs]
-        if self.dp > 1:
+        if self.mp > 1:
+            # GSPMD path: the exported module is inlined into the jit, the
+            # params arrive tensor-sharded (see _init_tensor_parallel), and
+            # XLA inserts the mp collectives.
+            from jax.sharding import NamedSharding
+
+            for i, a in enumerate(arrays):
+                if a.ndim >= 2 and self.dp > 1 and a.shape[0] % self.dp:
+                    raise ValueError(
+                        f"input {i}: leading dim {a.shape[0]} not divisible "
+                        f"by the mesh's dp={self.dp} (mp serving partitions "
+                        f"the exported batch across the data axes)")
+            key = tuple((a.shape, str(a.dtype)) for a in arrays)
+            fn = self._sharded_calls.get(key)
+            if fn is None:
+                in_sh = tuple(
+                    NamedSharding(self.mesh,
+                                  P(self._batch_axes) if a.ndim >= 2 else P())
+                    for a in arrays)
+                fn = jax.jit(self.exported.call,
+                             in_shardings=(self._param_shardings,) + in_sh)
+                self._sharded_calls[key] = fn
+            with self.mesh:
+                out = fn(self.params, *arrays)
+        elif self.dp > 1:
             in_specs = (P(),) + tuple(self._spec_for(a, i)
                                       for i, a in enumerate(arrays))
             fn = self._sharded_calls.get(in_specs)

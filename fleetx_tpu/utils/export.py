@@ -26,19 +26,43 @@ from fleetx_tpu.utils.log import logger
 _SEP = "/"
 
 
+def _path_key(path) -> str:
+    """Tree path → flat ``params.npz``/``meta.json`` key (one encoding shared
+    by save and load so the round-trip cannot drift)."""
+    return _SEP.join(getattr(p, "key", str(getattr(p, "idx", p)))
+                     for p in path)
+
+
 def _flatten_params(params: Any) -> dict[str, np.ndarray]:
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    out = {}
-    for path, leaf in flat:
-        key = _SEP.join(getattr(p, "key", str(getattr(p, "idx", p)))
-                        for p in path)
-        out[key] = np.asarray(leaf)
+    return {_path_key(path): np.asarray(leaf) for path, leaf in flat}
+
+
+def _encode_spec(spec: Any) -> list:
+    """PartitionSpec of LOGICAL axis names → JSON ([axis | [axes...] | null])."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(str(entry))
     return out
 
 
 def export_model(fn: Callable, example_args: Sequence[Any], out_dir: str,
-                 params: Any, platforms: Sequence[str] = ("cpu", "tpu")) -> None:
-    """AOT-export ``fn(params, *inputs)`` and save with its parameters."""
+                 params: Any, platforms: Sequence[str] = ("cpu", "tpu"),
+                 param_specs: Any = None) -> None:
+    """AOT-export ``fn(params, *inputs)`` and save with its parameters.
+
+    ``param_specs``: optional pytree (same structure as ``params``) of
+    LOGICAL-axis ``PartitionSpec``s (``nn.get_partition_spec`` of the boxed
+    params). Saved in ``meta.json`` so ``InferenceEngine`` can serve the
+    export tensor-parallel — the analogue of the reference's per-rank
+    mp-sharded exports (``inference_engine.py:128-163``), except one
+    artifact serves ANY mp degree.
+    """
     os.makedirs(out_dir, exist_ok=True)
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
@@ -52,6 +76,11 @@ def export_model(fn: Callable, example_args: Sequence[Any], out_dir: str,
         "in_avals": [str(a) for a in jax.tree.leaves(abstract)],
         "platforms": list(platforms),
     }
+    if param_specs is not None:
+        flat = jax.tree_util.tree_flatten_with_path(
+            param_specs, is_leaf=lambda x: not isinstance(x, dict))[0]
+        meta["param_specs"] = {_path_key(path): _encode_spec(spec)
+                               for path, spec in flat}
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f, indent=2)
     logger.info("exported model to %s (platforms=%s)", out_dir, list(platforms))
@@ -75,3 +104,20 @@ def load_exported(out_dir: str) -> tuple[Any, Any]:
     arrays = np.load(os.path.join(out_dir, "params.npz"))
     params = _unflatten_params({k: arrays[k] for k in arrays.files})
     return exp, params
+
+
+def load_param_specs(out_dir: str) -> Any:
+    """The export's saved LOGICAL ``PartitionSpec`` tree (same dict structure
+    as the params), or None when the artifact predates ``param_specs``."""
+    from jax.sharding import PartitionSpec as P
+
+    with open(os.path.join(out_dir, "meta.json")) as f:
+        meta = json.load(f)
+    if "param_specs" not in meta:
+        return None
+
+    def decode(entries):
+        return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+
+    return _unflatten_params({k: decode(v)
+                              for k, v in meta["param_specs"].items()})

@@ -112,6 +112,68 @@ def test_dp_inference_matches_single_device(tmp_path, devices8):
         np.testing.assert_allclose(got[i:i + 1], want, rtol=1e-6, atol=1e-6)
 
 
+def test_mp_inference_matches_single_device(tmp_path, devices8):
+    """Tensor-parallel AOT serving (VERDICT r3 #5; reference mp-sharded
+    exports, ``inference_engine.py:128-163``): one artifact exported
+    single-device serves on an mp2 mesh — params placed by the export's
+    saved logical specs, GSPMD partitioning the inlined StableHLO — with
+    outputs identical to the single-device call."""
+    from flax.core import meta
+
+    from fleetx_tpu.parallel.mesh import build_mesh
+    from fleetx_tpu.utils.export import load_param_specs
+
+    module = GPTModule(CFG)
+    b = _batch(b=2)
+    boxed = module.init_variables(jax.random.PRNGKey(0), b)
+    import flax.linen as nn
+    specs = nn.get_partition_spec(boxed)
+    params = meta.unbox(boxed)
+
+    def fn(params, tokens, position_ids):
+        return module.model.apply({"params": params}, tokens, position_ids,
+                                  deterministic=True)
+
+    export_model(fn, (b["tokens"], b["position_ids"]), str(tmp_path), params,
+                 platforms=("cpu",), param_specs=specs)
+    assert load_param_specs(str(tmp_path)) is not None
+
+    mesh = build_mesh({"mp_degree": 2}, devices=devices8[:2])
+    eng = InferenceEngine(str(tmp_path), mesh=mesh)
+    assert eng.mp == 2
+    # the qkv kernel really is sharded over the tensor axis
+    qkv = eng.params["gpt"]["layers"]["attn"]["qkv_kernel"]
+    assert "tensor" in str(qkv.sharding.spec)
+
+    got = eng.predict([b["tokens"], b["position_ids"]])[0]
+    want = InferenceEngine(str(tmp_path)).predict(
+        [b["tokens"], b["position_ids"]])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_mp_inference_requires_specs(tmp_path, devices8):
+    """An artifact without param_specs must fail loudly on an mp mesh."""
+    from flax.core import meta
+
+    import pytest
+
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    module = GPTModule(CFG)
+    b = _batch(b=2)
+    params = meta.unbox(module.init_variables(jax.random.PRNGKey(0), b))
+
+    def fn(params, tokens, position_ids):
+        return module.model.apply({"params": params}, tokens, position_ids,
+                                  deterministic=True)
+
+    export_model(fn, (b["tokens"], b["position_ids"]), str(tmp_path), params,
+                 platforms=("cpu",))
+    with pytest.raises(ValueError, match="param_specs"):
+        InferenceEngine(str(tmp_path),
+                        mesh=build_mesh({"mp_degree": 2}, devices=devices8[:2]))
+
+
 def test_dp_inference_rejects_nondivisible_batch(tmp_path, devices8):
     """A batch that doesn't divide dp must raise, not silently replicate."""
     from flax.core import meta

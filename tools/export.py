@@ -30,29 +30,35 @@ from fleetx_tpu.utils.log import logger
 
 
 def load_params(cfg, module):
-    """Restore params-only from the configured checkpoint, else fresh init."""
+    """Restore params-only from the configured checkpoint, else fresh init.
+
+    → (params, logical PartitionSpec tree) — the specs ride along in the
+    export artifact so ``InferenceEngine`` can serve it tensor-parallel.
+    """
+    import flax.linen as nn
     from flax.core import meta
 
     eng = dict(cfg.get("Engine") or {})
     ckpt_dir = (dict(eng.get("save_load") or {})).get("ckpt_dir")
     spec = module.input_spec()
     sample = {k: jnp.zeros(v.shape, v.dtype) for k, v in spec.items()}
-    params = module.init_variables(jax.random.PRNGKey(0), sample)
-    params = meta.unbox(params)
+    boxed = module.init_variables(jax.random.PRNGKey(0), sample)
+    param_specs = nn.get_partition_spec(boxed)
+    params = meta.unbox(boxed)
     step = ckpt_lib.latest_step(ckpt_dir) if ckpt_dir else None
     if step is not None:
         params = ckpt_lib.load_params(ckpt_dir, step)
         logger.info("restored params from %s step %d", ckpt_dir, step)
     else:
         logger.warning("no checkpoint configured/found — exporting fresh init")
-    return params
+    return params, param_specs
 
 
 def main():
     args = config_mod.parse_args("fleetx_tpu export")
     cfg = config_mod.get_config(args.config, args.override, show=True)
     module = build_module(cfg)
-    params = load_params(cfg, module)
+    params, param_specs = load_params(cfg, module)
 
     inf = dict(cfg.get("Inference") or {})
     out_dir = inf.get("model_dir", "./exported")
@@ -80,7 +86,8 @@ def main():
         spec = module.input_spec()
         example = tuple(spec[k] for k in ("tokens", "position_ids"))
 
-    export_model(fn, example, out_dir, params)
+    export_model(fn, example, out_dir, params,
+                 param_specs=param_specs)
     logger.info("export done: %s (target=%s)", out_dir, target)
 
 
