@@ -252,7 +252,9 @@ class ErnieForPretraining(nn.Module):
         return mlm_logits, nsp_logits
 
 
-IGNORE_INDEX = -1
+# unmasked-position sentinel in mlm_labels; matches the datasets'
+# convention (ernie_dataset.apply_mlm_mask) and the HF ecosystem
+IGNORE_INDEX = -100
 
 
 def pretraining_criterion(mlm_logits: jax.Array, nsp_logits: jax.Array,

@@ -104,10 +104,79 @@ def test_ernie_trains_sharded(devices8):
     assert losses[-1] < losses[0]
 
 
+def test_nsp_signal_is_learnable(tmp_path):
+    """The NSP objective must carry real signal (VERDICT r3 #6): with docs
+    drawn from distinct token bands, adjacent-pair positives vs
+    cross-document negatives are linearly separable, so a tiny encoder
+    reaches > 0.8 NSP accuracy in a few hundred steps — the old swap-order
+    sampling was stuck at exactly 0.5 forever."""
+    import optax
+
+    from fleetx_tpu.data.dataset.ernie_dataset import ErnieDataset
+    from fleetx_tpu.data.dataset.gpt_dataset import write_corpus
+
+    rng = np.random.RandomState(0)
+    # 8 docs, each over its own 12-token band → same-band ⇒ same-doc
+    docs = [list(rng.randint(4 + 12 * j, 4 + 12 * (j + 1),
+                             size=rng.randint(120, 160)))
+            for j in range(8)]
+    prefix = str(tmp_path / "corpus")
+    write_corpus(prefix, docs)
+    ds = ErnieDataset(prefix, num_samples=4096, seq_length=32, vocab_size=100)
+
+    # sanity: positives are adjacent same-doc spans, negatives cross-doc
+    labels = np.array([ds[i]["next_sentence_labels"] for i in range(64)])
+    assert 10 < labels.sum() < 54  # both classes present
+
+    cfg = tiny_cfg(vocab_size=100, num_layers=2, hidden_size=64)
+    model = ErnieForPretraining(cfg)
+
+    def collate(idxs):
+        items = [ds[int(i)] for i in idxs]
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.asarray(collate(range(8))["input_ids"]))["params"]
+    opt = optax.adam(2e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            mlm, nsp = model.apply(
+                {"params": p}, batch["input_ids"], batch["token_type_ids"],
+                attention_mask=batch["attention_mask"])
+            loss, _, _ = pretraining_criterion(
+                mlm, nsp, batch["mlm_labels"], batch["next_sentence_labels"])
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    bs = 32
+    for it in range(250):
+        b = collate(range(it * bs % 3200, it * bs % 3200 + bs))
+        params, opt_state, loss = step(params, opt_state, b)
+
+    # fresh (unseen) samples
+    test = collate(range(3600, 3600 + 128))
+    _, nsp_logits = model.apply(
+        {"params": params}, test["input_ids"], test["token_type_ids"],
+        attention_mask=test["attention_mask"])
+    acc = float((np.argmax(np.asarray(nsp_logits), -1)
+                 == test["next_sentence_labels"]).mean())
+    assert acc > 0.8, f"NSP accuracy {acc} — the objective carries no signal"
+
+
 def test_ernie_datasets(tmp_path):
     """MLM masking contract + memmap sentence-pair dataset."""
+    from fleetx_tpu.data.dataset import ernie_dataset as ed
     from fleetx_tpu.data.dataset.ernie_dataset import (
         ErnieDataset, SyntheticErnieDataset, apply_mlm_mask)
+
+    # the data side keeps its own literal (so workers never import jax);
+    # it must stay equal to the criterion's sentinel
+    assert ed.IGNORE_INDEX == IGNORE_INDEX
     from fleetx_tpu.data.dataset.gpt_dataset import write_corpus
 
     rng = np.random.RandomState(0)
