@@ -23,6 +23,7 @@ Checkpointing is sharding-aware and topology-free (``core/checkpoint.py``).
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Any, Iterable, Optional
 
@@ -161,6 +162,8 @@ class EagerEngine(BasicEngine):
         self._eval_step = None
         self._consumed_samples = 0
         self._start_epoch = 0
+        # fault injection for restart/elasticity tests (tools/supervise.py)
+        self._fault_step = int(os.environ.get("FLEETX_FAULT_STEP") or 0)
 
     # ------------------------------------------------------------- contexts
     def _ctx(self):
@@ -421,6 +424,13 @@ class EagerEngine(BasicEngine):
                         step != last_save:
                     last_save = step
                     self.save()
+                if self._fault_step and start_step == 0 and \
+                        step >= self._fault_step:
+                    # fault injection (tests/tools/supervise.py): die hard on
+                    # a FRESH run only — a resumed process sails past, which
+                    # is exactly the restart-with-resume behaviour under test
+                    logger.error("fault injection: dying at step %d", step)
+                    os._exit(17)
             if self._profiling:
                 jax.profiler.stop_trace()
                 self._profiling = False

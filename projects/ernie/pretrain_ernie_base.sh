@@ -3,5 +3,6 @@
 set -eux
 cd "$(dirname "$0")/../.."
 
-python tools/train.py \
+python tools/supervise.py --max-restart 3 -- \
+    python tools/train.py \
     -c fleetx_tpu/configs/nlp/ernie/pretrain_ernie_base.yaml "$@"

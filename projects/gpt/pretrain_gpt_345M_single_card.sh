@@ -5,5 +5,6 @@
 set -eux
 cd "$(dirname "$0")/../.."
 
-python tools/train.py \
+python tools/supervise.py --max-restart 3 -- \
+    python tools/train.py \
     -c fleetx_tpu/configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml "$@"

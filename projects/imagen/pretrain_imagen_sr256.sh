@@ -3,5 +3,6 @@
 set -eux
 cd "$(dirname "$0")/../.."
 
-python tools/train.py \
+python tools/supervise.py --max-restart 3 -- \
+    python tools/train.py \
     -c fleetx_tpu/configs/multimodal/imagen/imagen_super_resolution_256.yaml "$@"

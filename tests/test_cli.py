@@ -219,6 +219,36 @@ def test_train_eval_generate_cli_round_trip(tmp_path):
         (proc.stdout + proc.stderr)[-800:]
 
 
+def test_supervisor_restarts_after_crash(tmp_path):
+    """Restart wrapper e2e (VERDICT r3 #8; reference ``max_restart: 3``,
+    ``docs/quick_start.md:141``): training is killed mid-run by fault
+    injection, the supervisor restarts it, the retry resumes from the last
+    checkpoint and completes — one command, zero operator involvement."""
+    out_dir = str(tmp_path / "output")
+    env_extra = {"FLEETX_FAULT_STEP": "3"}
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               **env_extra)
+    cmd = [sys.executable, "tools/supervise.py", "--max-restart", "2",
+           "--backoff", "0", "--",
+           sys.executable, "tools/train.py", "-c",
+           "fleetx_tpu/configs/nlp/gpt/pretrain_gpt_345M_synthetic.yaml",
+           "-o", "Engine.max_steps=6", "-o", "Engine.logging_freq=1",
+           "-o", "Engine.eval_freq=0", "-o", "Engine.save_load.save_steps=2",
+           "-o", f"Engine.save_load.output_dir={out_dir}",
+           "-o", f"Engine.save_load.ckpt_dir={out_dir}"] \
+        + BATCH_FLAGS + GPT_SHAPES
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=600)
+    text = proc.stdout + proc.stderr
+    assert proc.returncode == 0, text[-3000:]
+    assert "fault injection: dying at step 3" in text, text[-2000:]
+    assert "[supervise] restart 1/2" in text, text[-2000:]
+    # the retry resumed (step > 0 checkpoint found) and finished all 6 steps
+    from fleetx_tpu.core import checkpoint as ckpt_lib
+    assert ckpt_lib.latest_step(out_dir) == 6, os.listdir(out_dir)
+
+
 def test_imagen_generate_cli(tmp_path):
     """tasks/imagen/generate.py samples the cascade (tiny shapes, few
     denoise steps) and writes the image tensor."""

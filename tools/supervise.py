@@ -1,0 +1,53 @@
+"""Restart supervisor — the ``paddle.distributed.launch`` elasticity analogue.
+
+Reference runs inherit ``max_restart: 3`` from the launcher
+(``/root/reference/docs/quick_start.md:141``); this repo's recipes exec
+``tools/train.py`` bare, so a crashed step killed the run even though
+checkpoint-resume works. This wrapper re-execs the training command until it
+exits cleanly, up to ``--max-restart`` times: each retry resumes from the
+last checkpoint (``Engine.save_load`` step/rng/consumed_samples restore —
+``core/checkpoint.py`` + ``tools/train.py``'s sampler wiring).
+
+Usage (what ``projects/*.sh`` invoke)::
+
+    python tools/supervise.py [--max-restart N] -- python tools/train.py -c cfg.yaml ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="fleetx restart supervisor")
+    parser.add_argument("--max-restart", type=int, default=3,
+                        help="restarts after a non-zero exit (reference "
+                             "launcher default: 3)")
+    parser.add_argument("--backoff", type=float, default=5.0,
+                        help="seconds to wait before a restart")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- followed by the training command")
+    args = parser.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        parser.error("no command given (expected: -- python tools/train.py ...)")
+
+    for attempt in range(args.max_restart + 1):
+        if attempt:
+            print(f"[supervise] restart {attempt}/{args.max_restart} "
+                  f"(resuming from last checkpoint) ...", file=sys.stderr)
+            time.sleep(args.backoff)
+        rc = subprocess.call(cmd)
+        if rc == 0:
+            return 0
+        print(f"[supervise] command exited rc={rc}", file=sys.stderr)
+    print(f"[supervise] giving up after {args.max_restart} restarts",
+          file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
