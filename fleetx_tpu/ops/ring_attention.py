@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_attention_local"]
+__all__ = ["ring_attention", "ring_attention_local", "ring_flash_local",
+           "flash_ring_supported"]
 
 _NEG_INF = -1e30
 
@@ -101,22 +102,184 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# flash-composed ring (VERDICT r3 #9): the per-ring-step block attention runs
+# on the Pallas MXU kernels instead of einsums-in-HBM
+# ---------------------------------------------------------------------------
+
+
+def flash_ring_supported(q: jax.Array, ring: int) -> bool:
+    """True when each device's local block (global seq / ``ring``) satisfies
+    the Pallas kernel contract."""
+    from fleetx_tpu.ops import flash_attention as fa
+
+    if fa.pltpu is None or q.ndim != 4 or q.shape[1] % max(ring, 1):
+        return False
+    s_loc, d = q.shape[1] // ring, q.shape[3]
+    return s_loc >= 128 and s_loc % 128 == 0 and d in (64, 128, 256)
+
+
+def _to3(x):
+    b, s, n, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+
+
+def _ring_perm(axis_name):
+    ring = lax.axis_size(axis_name)
+    return [(r, (r + 1) % ring) for r in range(ring)]
+
+
+def _ring_flash_fwd_pass(q3, k3, v3, axis_name, block):
+    """Ring forward on the Pallas kernel: per-step (out, lse) folded through
+    the online-logsumexp merge. Block structure per device ``me`` at step
+    ``t`` (holding block ``j = (me - t) % ring``): ``t == 0`` → causal
+    self-block; ``t <= me`` → fully-visible earlier block; else skipped."""
+    from fleetx_tpu.ops import flash_attention as fa
+
+    ring = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    bn, s, d = q3.shape
+    scale = d ** -0.5
+    seed = jnp.zeros((1,), jnp.int32)
+
+    def block_fwd(k_b, v_b, causal):
+        return fa._fwd(q3, k_b, v_b, seed, scale=scale, causal=causal,
+                       block_q=block, block_k=block, dropout_rate=0.0)
+
+    out, lse = block_fwd(k3, v3, True)  # t = 0: the causal diagonal
+    out = out.astype(jnp.float32)
+    k_cur, v_cur = k3, v3
+    for t in range(1, ring):
+        k_cur = lax.ppermute(k_cur, axis_name, _ring_perm(axis_name))
+        v_cur = lax.ppermute(v_cur, axis_name, _ring_perm(axis_name))
+
+        def visible(args):
+            o_acc, l_acc, k_b, v_b = args
+            o_t, l_t = block_fwd(k_b, v_b, False)
+            l_new = jnp.logaddexp(l_acc, l_t)
+            o_new = (o_acc * jnp.exp(l_acc - l_new)[..., None]
+                     + o_t.astype(jnp.float32)
+                     * jnp.exp(l_t - l_new)[..., None])
+            return o_new, l_new
+
+        out, lse = lax.cond(t <= me, visible,
+                            lambda args: (args[0], args[1]),
+                            (out, lse, k_cur, v_cur))
+    return out.astype(q3.dtype), lse
+
+
+def ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                     axis_name: str = "seq") -> jax.Array:
+    """Causal ring attention whose per-block math runs on the Pallas flash
+    kernels (``ops/flash_attention.py``) — forward merges per-block
+    (out, lse) pairs; backward re-rotates K/V and runs the dq/dkv kernels
+    against the GLOBAL logsumexp. Exact, differentiable, O(s_local) memory.
+
+    Same contract as ``ring_attention_local`` (call inside ``shard_map``
+    with ``axis_name`` manual; q/k/v ``[b, s_local, n, d]``), restricted to
+    causal self-attention without dropout.
+    """
+    from fleetx_tpu.ops import flash_attention as fa
+
+    b, s_loc, n, d = q.shape
+    block = fa.pick_block(s_loc, d)
+    q3, k3, v3 = _to3(q), _to3(k), _to3(v)
+    out3 = _ring_flash3(q3, k3, v3, axis_name, block)
+    return out3.reshape(b, n, s_loc, d).transpose(0, 2, 1, 3)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash3(q3, k3, v3, axis_name, block):
+    out, _ = _ring_flash_fwd_pass(q3, k3, v3, axis_name, block)
+    return out
+
+
+def _ring_flash3_fwd(q3, k3, v3, axis_name, block):
+    out, lse = _ring_flash_fwd_pass(q3, k3, v3, axis_name, block)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _ring_flash3_bwd(axis_name, block, residuals, g):
+    from fleetx_tpu.ops import flash_attention as fa
+
+    q3, k3, v3, out, lse = residuals
+    do = g
+    ring = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    bn, s, d = q3.shape
+    scale = d ** -0.5
+    seed = jnp.zeros((1,), jnp.int32)
+    # p = exp(s - GLOBAL lse) makes the per-block backward exact
+    delta = (out.astype(jnp.float32) * do.astype(jnp.float32)).sum(axis=-1)
+    lse3, delta3 = lse[..., None], delta[..., None]
+
+    def block_bwd(k_b, v_b, causal):
+        dq_b = fa._bwd_dq(q3, k_b, v_b, do, lse3, delta3, seed, scale=scale,
+                          causal=causal, block_q=block, block_k=block)
+        dk_b, dv_b = fa._bwd_dkv(q3, k_b, v_b, do, lse3, delta3, seed,
+                                 scale=scale, causal=causal, block_q=block,
+                                 block_k=block)
+        return dq_b, dk_b, dv_b
+
+    dq_d, dk_d, dv_d = block_bwd(k3, v3, True)  # diagonal
+    dq = dq_d.astype(jnp.float32)
+    k_cur, v_cur = k3, v3
+    dk_cur = dk_d.astype(jnp.float32)
+    dv_cur = dv_d.astype(jnp.float32)
+    for t in range(1, ring):
+        # dk/dv accumulators travel WITH their k/v block around the ring
+        k_cur = lax.ppermute(k_cur, axis_name, _ring_perm(axis_name))
+        v_cur = lax.ppermute(v_cur, axis_name, _ring_perm(axis_name))
+        dk_cur = lax.ppermute(dk_cur, axis_name, _ring_perm(axis_name))
+        dv_cur = lax.ppermute(dv_cur, axis_name, _ring_perm(axis_name))
+
+        def visible(args):
+            dq_acc, dk_acc, dv_acc, k_b, v_b = args
+            dq_b, dk_b, dv_b = block_bwd(k_b, v_b, False)
+            return (dq_acc + dq_b.astype(jnp.float32),
+                    dk_acc + dk_b.astype(jnp.float32),
+                    dv_acc + dv_b.astype(jnp.float32))
+
+        dq, dk_cur, dv_cur = lax.cond(
+            t <= me, visible, lambda args: (args[0], args[1], args[2]),
+            (dq, dk_cur, dv_cur, k_cur, v_cur))
+    # after ring-1 hops the accumulators sit one hop short of home
+    dk_cur = lax.ppermute(dk_cur, axis_name, _ring_perm(axis_name))
+    dv_cur = lax.ppermute(dv_cur, axis_name, _ring_perm(axis_name))
+    return (dq.astype(q3.dtype), dk_cur.astype(k3.dtype),
+            dv_cur.astype(v3.dtype))
+
+
+_ring_flash3.defvjp(_ring_flash3_fwd, _ring_flash3_bwd)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    causal: bool = True, axis_name: str = "seq",
-                   kv_chunk: int | None = None, mesh=None) -> jax.Array:
+                   kv_chunk: int | None = None, mesh=None,
+                   use_flash: bool | None = None) -> jax.Array:
     """Sequence-parallel attention: q/k/v ``[b, s, n, d]`` with ``s`` sharded
     over ``axis_name``. Must run inside jit under the mesh context (the
     engine's ``_ctx``); all other axes stay GSPMD-automatic. ``kv_chunk``
-    bounds per-ring-step score memory (see ``ring_attention_local``)."""
+    bounds per-ring-step score memory on the einsum path
+    (see ``ring_attention_local``).
+
+    ``use_flash`` None (auto) routes causal calls whose local block fits the
+    Pallas contract through ``ring_flash_local`` — per-block attention on
+    the MXU kernels, the einsum path kept as fallback/reference.
+    """
     if mesh is None:
         from fleetx_tpu.parallel.mesh import current_mesh
 
         mesh = current_mesh()
     assert mesh is not None, "ring_attention needs an ambient or explicit mesh"
+    ring = mesh.shape.get(axis_name, 1)
+    if use_flash is None:
+        use_flash = causal and flash_ring_supported(q, ring)
+    body = (partial(ring_flash_local, axis_name=axis_name) if use_flash
+            else partial(ring_attention_local, axis_name=axis_name,
+                         causal=causal, kv_chunk=kv_chunk))
     spec = P(None, axis_name)
     fn = jax.shard_map(
-        partial(ring_attention_local, axis_name=axis_name, causal=causal,
-                kv_chunk=kv_chunk),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names=frozenset({axis_name}), check_vma=False)
     return fn(q, k, v)

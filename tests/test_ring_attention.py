@@ -60,6 +60,41 @@ def test_ring_gradients_match(devices8):
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("ring", [2, 4])
+def test_flash_ring_matches_reference(devices8, ring):
+    """Flash-composed ring (VERDICT r3 #9): per-block Pallas kernels +
+    global-lse backward reproduce reference attention values AND grads.
+    Shapes chosen so the local block (128/dev) satisfies the kernel
+    contract, i.e. the auto-selection really takes the flash path."""
+    from fleetx_tpu.ops.ring_attention import flash_ring_supported
+
+    rng = np.random.RandomState(0)
+    b, s, n, d = 2, 128 * ring, 2, 64
+    q = jnp.asarray(rng.randn(b, s, n, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, n, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, n, d), jnp.float32)
+    assert flash_ring_supported(q, ring)
+    want = fa.reference_attention(q, k, v, causal=True)
+
+    mesh = build_mesh({"seq_degree": ring}, devices=devices8[:ring])
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, causal=True, use_flash=True))(q, k, v)
+        g_ring = jax.jit(jax.grad(
+            lambda q, k, v: (ring_attention(q, k, v, causal=True,
+                                            use_flash=True) ** 2).sum(),
+            argnums=(0, 1, 2)))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(
+        lambda q, k, v: (fa.reference_attention(q, k, v, causal=True) ** 2
+                         ).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, c in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   rtol=1e-3, atol=2e-4)
+
+
 VOCAB, SEQ, BATCH = 128, 32, 8
 
 
