@@ -35,7 +35,7 @@ def min_length_processor(min_length: int, eos_token_id: int):
     """Suppress eos before ``min_length`` generated tokens
     (reference ``MinLengthLogitsProcessor``)."""
 
-    def apply(logits, generated_len, sequences):
+    def apply(logits, generated_len, sequences, sequences_mask=None):
         return jnp.where(
             (generated_len < min_length)
             & (jnp.arange(logits.shape[-1]) == eos_token_id)[None, :],
@@ -45,19 +45,28 @@ def min_length_processor(min_length: int, eos_token_id: int):
 
 
 def repetition_penalty_processor(penalty: float):
-    """Divide positive / multiply negative scores of already-emitted tokens
-    (reference ``RepetitionPenaltyLogitsProcessor``)."""
+    """Divide positive / multiply negative scores of already-present tokens
+    (reference ``RepetitionPenaltyLogitsProcessor`` — penalises the whole
+    context so far, prompt AND generated).
 
-    def apply(logits, generated_len, sequences):
+    ``sequences_mask`` marks which slots of ``sequences`` hold real tokens
+    (left-pad prompt slots and not-yet-generated slots are False); without
+    it, the first ``generated_len`` slots count. Unmarked slots hold the
+    pad id, which may alias a real token id — scatter-max so a pad-id
+    duplicate at an invalid slot cannot erase a real hit.
+    """
+
+    def apply(logits, generated_len, sequences, sequences_mask=None):
         if penalty == 1.0:
             return logits
         b, v = logits.shape
-        seen = jnp.zeros((b, v), bool)
-        one = jnp.ones((b, sequences.shape[1]), bool)
-        seen = seen.at[jnp.arange(b)[:, None], sequences].set(one)
-        # pad slots in `sequences` hold a valid token id; callers pass
-        # sequences already masked to a sentinel inside the vocab is fine
-        # because penalising a never-sampled token is a no-op in practice
+        if sequences_mask is None:
+            sequences_mask = jnp.broadcast_to(
+                jnp.arange(sequences.shape[1])[None, :] < generated_len,
+                sequences.shape)
+        valid = sequences_mask.astype(jnp.int32).reshape(sequences.shape)
+        seen = jnp.zeros((b, v), jnp.int32)
+        seen = seen.at[jnp.arange(b)[:, None], sequences].max(valid) > 0
         penalised = jnp.where(logits > 0, logits / penalty, logits * penalty)
         return jnp.where(seen, penalised, logits)
 
@@ -67,7 +76,7 @@ def repetition_penalty_processor(penalty: float):
 def forced_bos_processor(bos_token_id: int):
     """Force the first generated token (reference ``ForcedBOSTokenLogitsProcessor``)."""
 
-    def apply(logits, generated_len, sequences):
+    def apply(logits, generated_len, sequences, sequences_mask=None):
         forced = jnp.full_like(logits, NEG_INF).at[:, bos_token_id].set(0.0)
         return jnp.where(generated_len == 0, forced, logits)
 
@@ -77,7 +86,7 @@ def forced_bos_processor(bos_token_id: int):
 def forced_eos_processor(max_length: int, eos_token_id: int):
     """Force eos at the length limit (reference ``ForcedEOSTokenLogitsProcessor``)."""
 
-    def apply(logits, generated_len, sequences):
+    def apply(logits, generated_len, sequences, sequences_mask=None):
         forced = jnp.full_like(logits, NEG_INF).at[:, eos_token_id].set(0.0)
         return jnp.where(generated_len == max_length - 1, forced, logits)
 
@@ -226,9 +235,17 @@ def generate(model, params: Any, gen_cfg: GenerationConfig,
         processors.append(forced_eos_processor(gen_cfg.max_new_tokens,
                                                gen_cfg.forced_eos_token_id))
 
-    def sample_token(logits, step, sequences, rng):
+    def sample_token(logits, step, ctx, rng):
+        # processors see the FULL context (prompt + generated so far) with a
+        # validity mask — left-pad prompt slots and unfilled generated
+        # slots excluded (reference processors run on the whole input_ids)
+        gen_valid = jnp.broadcast_to(
+            jnp.arange(gen_cfg.max_new_tokens)[None, :] < step,
+            (b, gen_cfg.max_new_tokens))
+        ctx_mask = jnp.concatenate(
+            [attention_mask.astype(bool), gen_valid], axis=1)
         for proc in processors:
-            logits = proc(logits, step, sequences)
+            logits = proc(logits, step, ctx, ctx_mask)
         if gen_cfg.do_sample:
             logits = apply_temperature(logits, gen_cfg.temperature)
             logits = apply_top_k(logits, gen_cfg.top_k)
@@ -236,11 +253,14 @@ def generate(model, params: Any, gen_cfg: GenerationConfig,
             return jax.random.categorical(rng, logits, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
-    sequences0 = jnp.full((b, gen_cfg.max_new_tokens), gen_cfg.pad_token_id,
-                          jnp.int32)
+    # ctx buffer = [prompt | generated]; slot validity is handled by the
+    # mask in sample_token, so pad slots can keep the pad id
+    ctx0 = jnp.concatenate(
+        [tokens, jnp.full((b, gen_cfg.max_new_tokens), gen_cfg.pad_token_id,
+                          jnp.int32)], axis=1)
     rng, sub = jax.random.split(rng)
-    first = sample_token(next_logits, jnp.int32(0), sequences0, sub)
-    sequences0 = sequences0.at[:, 0].set(first)
+    first = sample_token(next_logits, jnp.int32(0), ctx0, sub)
+    ctx0 = ctx0.at[:, prompt_len].set(first)
     done0 = first == gen_cfg.eos_token_id
     # position of the next token = number of real prompt tokens (+ step)
     base_pos = attention_mask.astype(jnp.int32).sum(axis=1)
@@ -250,19 +270,19 @@ def generate(model, params: Any, gen_cfg: GenerationConfig,
         return (step < gen_cfg.max_new_tokens) & ~jnp.all(done)
 
     def body(state):
-        step, cache, sequences, done, last, rng = state
+        step, cache, ctx, done, last, rng = state
         tok = jnp.where(done, gen_cfg.pad_token_id, last)[:, None]
         pos = (base_pos + step - 1)[:, None]
         logits, cache = model.apply(
             {"params": params}, tok, pos, cache=cache, deterministic=True)
         rng, sub = jax.random.split(rng)
-        nxt = sample_token(logits[:, -1].astype(jnp.float32), step, sequences, sub)
+        nxt = sample_token(logits[:, -1].astype(jnp.float32), step, ctx, sub)
         nxt = jnp.where(done, gen_cfg.pad_token_id, nxt)
-        sequences = jax.lax.dynamic_update_slice_in_dim(
-            sequences, nxt[:, None], step, axis=1)
+        ctx = jax.lax.dynamic_update_slice_in_dim(
+            ctx, nxt[:, None], prompt_len + step, axis=1)
         done = done | (nxt == gen_cfg.eos_token_id)
-        return step + 1, cache, sequences, done, nxt, rng
+        return step + 1, cache, ctx, done, nxt, rng
 
-    state = (jnp.int32(1), cache, sequences0, done0, first, rng)
-    _, _, sequences, _, _, _ = jax.lax.while_loop(cond, body, state)
-    return sequences
+    state = (jnp.int32(1), cache, ctx0, done0, first, rng)
+    _, _, ctx, _, _, _ = jax.lax.while_loop(cond, body, state)
+    return ctx[:, prompt_len:]

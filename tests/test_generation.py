@@ -98,11 +98,51 @@ def test_repetition_penalty():
     proc = G.repetition_penalty_processor(2.0)
     logits = jnp.asarray([[2.0, -2.0, 1.0]])
     seqs = jnp.asarray([[0, 1]], jnp.int32)  # tokens 0 and 1 already emitted
-    out = np.asarray(proc(logits, jnp.int32(1), seqs))
+    out = np.asarray(proc(logits, jnp.int32(2), seqs))
     np.testing.assert_allclose(out, [[1.0, -4.0, 1.0]])
+    # generated_len gates which slots count: at len 1 only token 0 is seen
+    out1 = np.asarray(proc(logits, jnp.int32(1), seqs))
+    np.testing.assert_allclose(out1, [[1.0, -2.0, 1.0]])
 
 
-def test_min_length_suppresses_eos():
+def test_repetition_penalty_covers_prompt_tokens(small_model):
+    """Reference RepetitionPenaltyLogitsProcessor parity: the penalty covers
+    the PROMPT tokens too, not just generated ones — left-pad slots stay
+    exempt. Asserted through the real generate() loop by checking the very
+    first sampled step against a hand-applied penalty."""
+    model, params, cfg = small_model
+    gen_cfg = G.GenerationConfig(max_new_tokens=1, do_sample=False,
+                                 repetition_penalty=10.0, eos_token_id=96,
+                                 pad_token_id=0)
+    prompts = [[7, 9, 11]]
+    tokens, mask = G.left_pad(prompts, 0, width=6)  # 3 left-pad slots of id 0
+    # hand-compute: logits of the prompt's last position (same mask +
+    # positions as the prefill)
+    pos = np.maximum(np.cumsum(mask, axis=1) - 1, 0).astype(np.int32)
+    logits = np.array(model.apply(
+        {"params": params}, jnp.asarray(tokens), jnp.asarray(pos),
+        deterministic=True,
+        attention_mask=jnp.asarray(mask))[0, -1], np.float32)
+    # make the check discriminating: put the would-be argmax INTO the
+    # prompt, so only prompt-aware penalisation changes the greedy pick
+    top = int(np.argmax(logits))
+    prompts = [[7, top, 11]]
+    tokens, mask = G.left_pad(prompts, 0, width=6)
+    pos = np.maximum(np.cumsum(mask, axis=1) - 1, 0).astype(np.int32)
+    logits = np.array(model.apply(
+        {"params": params}, jnp.asarray(tokens), jnp.asarray(pos),
+        deterministic=True,
+        attention_mask=jnp.asarray(mask))[0, -1], np.float32)
+    want = logits.copy()
+    for t in (7, top, 11):
+        want[t] = want[t] / 10.0 if want[t] > 0 else want[t] * 10.0
+    assert int(np.argmax(want)) != int(np.argmax(logits)) or top not in (
+        int(np.argmax(logits)),), "test setup lost its discriminating power"
+
+    out = G.generate(model, params, gen_cfg, jnp.asarray(tokens),
+                     jnp.asarray(mask), jax.random.PRNGKey(0))
+    # pad id 0 must NOT be penalised (left-pad slots are exempt)
+    assert int(out[0, 0]) == int(np.argmax(want))
     proc = G.min_length_processor(3, eos_token_id=1)
     logits = jnp.zeros((1, 4))
     early = np.asarray(proc(logits, jnp.int32(0), None))
