@@ -1,0 +1,112 @@
+"""Automatic mesh-layout planning — the TPU-native half of the reference's
+auto-parallel stack.
+
+The reference's semi-auto path (``ppfleetx/models/language_model/gpt/auto/
+auto_utils.py:24-108`` + ``utils/config.py:418-444``) builds a ProcessMesh
+from USER-supplied degrees and lets the framework place collectives; the
+placement half is GSPMD here (``AutoEngine`` docstring). This module supplies
+the other half the reference leaves to the user: choosing the degrees.
+
+``suggest_layout`` picks ``(dp, fsdp, mp, pp, seq)`` for a model + device
+count from a first-order memory model and TPU cost preferences:
+
+- training state is ~12 bytes/param on-device (f32 master params + two Adam
+  moments, reference FusedAdamW semantics) and must fit the per-device HBM
+  budget after sharding;
+- axis preference order is fsdp (ZeRO — cheapest collectives, rides the
+  same all-reduce dp already pays) → mp (tensor — adds per-layer
+  collectives, capped at 8 and by head divisibility) → pp (adds the
+  pipeline ramp). Models ≥ ~50B params invert to mp-then-pp (the
+  megatron-style recipe: tensor inside a chip group, pipeline across),
+  matching the reference's own 175B mp8×pp16 layout;
+- long-context configs (``max_position_embeddings`` ≥ 4096) reserve a
+  ``seq`` factor for ring attention when devices remain;
+- whatever is left becomes dp.
+"""
+
+from __future__ import annotations
+
+from fleetx_tpu.utils.log import logger
+
+_STATE_BYTES_PER_PARAM = 12  # f32 master + 2 Adam moments
+_HBM_BUDGET_FRACTION = 0.55  # leave room for activations/workspace
+
+
+def estimate_params(model: dict) -> int:
+    """First-order GPT-family parameter count from a ``Model:`` section."""
+    h = int(model.get("hidden_size") or 1024)
+    layers = int(model.get("num_layers") or 24)
+    ffn = int(model.get("ffn_hidden_size") or 4 * h)
+    vocab = int(model.get("vocab_size") or 50304)
+    seq = int(model.get("max_position_embeddings") or 1024)
+    per_layer = 4 * h * h + 2 * h * ffn + 9 * h  # qkv+out + mlp + norms/bias
+    return layers * per_layer + vocab * h + seq * h
+
+
+def suggest_layout(model: dict, n_devices: int, hbm_gb: float = 16.0) -> dict:
+    """→ ``Distributed``-section degrees whose product is ``n_devices``.
+
+    Deterministic and purely static — suitable for config-time planning on
+    any host (no devices touched).
+    """
+    n_params = estimate_params(model)
+    heads = int(model.get("num_attention_heads") or 16)
+    layers = int(model.get("num_layers") or 24)
+    seq_len = int(model.get("max_position_embeddings") or 1024)
+    budget = hbm_gb * (1 << 30) * _HBM_BUDGET_FRACTION
+    state = float(_STATE_BYTES_PER_PARAM * n_params)
+
+    deg = {"fsdp": 1, "mp": 1, "pp": 1, "seq": 1}
+
+    def product() -> int:
+        return deg["fsdp"] * deg["mp"] * deg["pp"] * deg["seq"]
+
+    def fits() -> bool:
+        return state / (deg["fsdp"] * deg["mp"] * deg["pp"]) <= budget
+
+    def can_double(axis: str) -> bool:
+        if product() * 2 > n_devices:
+            return False
+        if axis == "mp":
+            return deg["mp"] < 8 and heads % (deg["mp"] * 2) == 0
+        if axis == "pp":
+            return layers % (deg["pp"] * 2) == 0
+        if axis == "fsdp":
+            return deg["fsdp"] < 16
+        return True
+
+    # megatron-style for huge models, ZeRO-first otherwise
+    order = (("mp", "pp", "fsdp") if n_params >= 50e9
+             else ("fsdp", "mp", "pp"))
+    for axis in order:
+        while not fits() and can_double(axis):
+            deg[axis] *= 2
+
+    if seq_len >= 4096:
+        while deg["seq"] < 4 and product() * 2 <= n_devices and \
+                seq_len % (256 * deg["seq"] * 2) == 0:
+            deg["seq"] *= 2
+
+    dp, rem = divmod(n_devices, product())
+    if rem:
+        raise ValueError(
+            f"auto layout {deg} does not divide {n_devices} devices")
+    out = {
+        "dp_degree": dp,
+        "fsdp_degree": deg["fsdp"],
+        "mp_degree": deg["mp"],
+        "pp_degree": deg["pp"],
+        "seq_degree": deg["seq"],
+    }
+    if deg["fsdp"] > 1:
+        out["sharding"] = {"sharding_stage": 2,
+                           "sharding_degree": deg["fsdp"]}
+    if not fits():
+        logger.warning(
+            "auto layout: %.1fGB state per device exceeds the %.1fGB budget "
+            "even at %s — expect recompute/offload to be required",
+            state / (deg["fsdp"] * deg["mp"] * deg["pp"]) / (1 << 30),
+            budget / (1 << 30), out)
+    logger.info("auto layout for %.2fB params on %d devices: %s",
+                n_params / 1e9, n_devices, out)
+    return out

@@ -231,11 +231,46 @@ def process_engine_config(config: AttrDict) -> AttrDict:
 
 
 def get_config(fname: str, overrides: list[str] | None = None, show: bool = False,
-               num_devices: int | None = None) -> AttrDict:
-    """Load + override + post-process a config (reference ``config.py:313-345``)."""
+               num_devices: int | None = None, auto_layout: bool = False) -> AttrDict:
+    """Load + override + post-process a config (reference ``config.py:313-345``).
+
+    ``auto_layout`` (or ``Distributed.auto_layout: true`` in the YAML) runs
+    the mesh-degree planner (``parallel/auto_layout.suggest_layout``) BEFORE
+    the batch/degree derivations, so local/micro batch math follows the
+    chosen layout — the reference ``get_auto_config`` analogue
+    (``config.py:447-464``) with the planning half actually automated.
+    """
     assert os.path.exists(fname), f"config file {fname} not found"
     config = parse_config(fname)
     override_config(config, overrides)
+    dist = config.get("Distributed") or {}
+    if auto_layout or dist.get("auto_layout"):
+        from fleetx_tpu.parallel.auto_layout import suggest_layout
+
+        if num_devices is None:
+            import jax
+
+            num_devices = jax.device_count()
+        explicit = {k for k in ("dp_degree", "mp_degree", "pp_degree",
+                                "fsdp_degree", "seq_degree")
+                    if int(dist.get(k) or 0) > 1}
+        if int((dist.get("sharding") or {}).get("sharding_degree") or 0) > 1:
+            explicit.add("sharding.sharding_degree")
+        if explicit:
+            logger.info("auto_layout: explicit degrees %s kept", explicit)
+        else:
+            layout = suggest_layout(dict(config.get("Model") or {}),
+                                    num_devices)
+            config.setdefault("Distributed", AttrDict())
+            for k, v in layout.items():
+                # merge (don't replace) the sharding sub-dict: the recipe
+                # may carry orthogonal keys like sharding_offload
+                if k == "sharding" and isinstance(
+                        config["Distributed"].get("sharding"), dict):
+                    config["Distributed"]["sharding"].update(v)
+                else:
+                    config["Distributed"][k] = v
+        config["Distributed"].pop("auto_layout", None)
     process_dist_config(config, num_devices=num_devices)
     process_global_configs(config)
     process_engine_config(config)

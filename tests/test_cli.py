@@ -64,6 +64,24 @@ def test_train_cli_gpt_synthetic():
     assert abs(losses[0] - 6.24) < 0.5, losses
 
 
+def test_auto_cli_plans_the_mesh():
+    """tools/auto.py runs the mesh-degree planner (the reference auto
+    stack's planning half) before batch derivation, then trains normally.
+    The dp override is dropped from the shared flags — an explicit degree
+    would (correctly) bypass the planner."""
+    flags = [f for pair in zip(TINY[::2], TINY[1::2])
+             for f in pair if "dp_degree" not in pair[1]]
+    proc = _run(["tools/auto.py", "-c",
+                 "fleetx_tpu/configs/nlp/gpt/auto/pretrain_gpt_345M_single_card.yaml",
+                 "-o", "Data.Train.dataset.name=SyntheticGPTDataset"]
+                + flags)
+    text = proc.stdout + proc.stderr
+    assert proc.returncode == 0, text[-2000:]
+    assert "auto layout" in text, text[-1500:]
+    losses = _losses(text)
+    assert losses and abs(losses[0] - 6.24) < 0.5, losses
+
+
 def test_train_cli_ernie_synthetic():
     proc = _run(["tools/train.py", "-c",
                  "fleetx_tpu/configs/nlp/ernie/pretrain_ernie_base.yaml",

@@ -1,9 +1,12 @@
 """Auto-parallel training entry point (reference ``tools/auto.py:270-296``).
 
 In the reference this drives a separate static-graph compilation stack; here
-GSPMD compilation is the only stack, so this is the same flow as
-``tools/train.py`` through ``AutoEngine`` (see
-``fleetx_tpu/core/engine/auto_engine.py`` for why the stacks merged).
+GSPMD compilation is the only stack (see
+``fleetx_tpu/core/engine/auto_engine.py`` for why the stacks merged), so the
+auto entry point's remaining job is the PLANNING half: it enables the
+mesh-degree planner (``parallel/auto_layout.suggest_layout``), which picks
+``(dp, fsdp, mp, pp, seq)`` from the model size and device count before the
+batch math derives — unless the config pins explicit degrees.
 """
 
 import os
@@ -14,4 +17,4 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 if __name__ == "__main__":
     import train
 
-    train.main()
+    train.main(auto_layout=True)

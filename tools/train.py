@@ -28,10 +28,11 @@ from fleetx_tpu.utils import env as env_mod
 from fleetx_tpu.utils.log import logger
 
 
-def main():
+def main(auto_layout: bool = False):
     args = config_mod.parse_args("fleetx_tpu train")
     env_mod.init_dist_env()
-    cfg = config_mod.get_config(args.config, args.override, show=True)
+    cfg = config_mod.get_config(args.config, args.override, show=True,
+                                auto_layout=auto_layout)
 
     from fleetx_tpu.utils.check import check_config
     check_config(cfg)
