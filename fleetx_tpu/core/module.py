@@ -315,6 +315,15 @@ class GPTGenerationModule(GPTModule):
         from fleetx_tpu.models.gpt.generation import GenerationConfig
 
         gen = dict(cfg.get("Generation") or {}) if isinstance(cfg, dict) else {}
+        # reference decode_strategy: "sampling" | "greedy_search" (the
+        # reference raises on greedy; here it is supported); the older
+        # use_topp_sampling flag is honoured when no strategy is given
+        strategy = gen.get("decode_strategy")
+        if strategy is not None:
+            assert strategy in ("sampling", "greedy_search"), strategy
+            do_sample = strategy == "sampling"
+        else:
+            do_sample = bool(gen.get("use_topp_sampling", True))
         self.gen_cfg = GenerationConfig(
             max_new_tokens=int(gen.get("max_dec_len", 64)),
             min_new_tokens=int(gen.get("min_dec_len", 0)),
@@ -322,7 +331,8 @@ class GPTGenerationModule(GPTModule):
             top_k=int(gen.get("top_k", 0)),
             top_p=float(gen.get("top_p", 0.0)),
             repetition_penalty=float(gen.get("repetition_penalty", 1.0)),
-            do_sample=bool(gen.get("use_topp_sampling", True)),
+            do_sample=do_sample,
+            num_return_sequences=int(gen.get("num_return_sequences", 1)),
             eos_token_id=int(gen.get("eos_token_id", 50256)),
             pad_token_id=int(gen.get("pad_token_id", 50256)),
         )
@@ -330,7 +340,9 @@ class GPTGenerationModule(GPTModule):
         super().__init__(cfg)
 
     def generate_ids(self, params: Any, prompts: list, rng: jax.Array):
-        """prompts: list of token-id lists → [b, max_new_tokens] numpy."""
+        """prompts: list of token-id lists →
+        ``[len(prompts) * num_return_sequences, max_new_tokens]`` numpy,
+        prompt-major (rows ``i*n .. i*n+n-1`` continue prompt ``i``)."""
         from flax.core import meta
         from fleetx_tpu.models.gpt import generation as G
 

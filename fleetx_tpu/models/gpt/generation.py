@@ -179,6 +179,10 @@ class GenerationConfig:
     top_p: float = 0.0
     repetition_penalty: float = 1.0
     do_sample: bool = True
+    # independent samples per prompt (reference num_return_sequences +
+    # expand_inputs_for_generation): outputs come back [b * n, new_tokens],
+    # prompt-major (rows i*n .. i*n+n-1 belong to prompt i)
+    num_return_sequences: int = 1
     eos_token_id: int = 50256
     pad_token_id: int = 50256
     forced_bos_token_id: Optional[int] = None
@@ -205,7 +209,9 @@ def generate(model, params: Any, gen_cfg: GenerationConfig,
              tokens: jax.Array, attention_mask: jax.Array,
              rng: jax.Array) -> jax.Array:
     """Sample continuations. ``tokens``/``attention_mask``: [b, prompt_len]
-    left-padded. Returns [b, max_new_tokens] (eos-padded after stop).
+    left-padded. Returns ``[b * num_return_sequences, max_new_tokens]``
+    (eos-padded after stop), prompt-major — with the default
+    ``num_return_sequences`` of 1 that is plain ``[b, max_new_tokens]``.
 
     The loop state carries (cache, last token, done flags, sequences buffer,
     rng); one iteration = one 1-token forward + processors + sampling —
@@ -213,15 +219,29 @@ def generate(model, params: Any, gen_cfg: GenerationConfig,
     (``hybrid_model.py:1303-1340``).
     """
     cfg: GPTConfig = model.cfg
-    b, prompt_len = tokens.shape
+    n_ret = max(int(gen_cfg.num_return_sequences), 1)
+    b0, prompt_len = tokens.shape
     total = prompt_len + gen_cfg.max_new_tokens
 
-    cache = init_cache(cfg, b, total)
+    cache = init_cache(cfg, b0, total)
     logits, cache = model.apply(
         {"params": params}, tokens, None, cache=cache, deterministic=True,
         attention_mask=attention_mask)
     # with left padding the last prompt position is always real
     next_logits = logits[:, -1].astype(jnp.float32)
+    if n_ret > 1:
+        # reference expand_inputs_for_generation (num_return_sequences):
+        # prefill runs ONCE per prompt; the cache/logits are repeated so
+        # only the decode loop pays per-sample (rows are prompt-major,
+        # independent via the batched categorical draws)
+        tokens = jnp.repeat(tokens, n_ret, axis=0)
+        attention_mask = jnp.repeat(attention_mask, n_ret, axis=0)
+        next_logits = jnp.repeat(next_logits, n_ret, axis=0)
+        cache = DecodeCache(key=jnp.repeat(cache.key, n_ret, axis=1),
+                            value=jnp.repeat(cache.value, n_ret, axis=1),
+                            index=cache.index,
+                            mask=jnp.repeat(cache.mask, n_ret, axis=0))
+    b = b0 * n_ret
 
     processors = []
     if gen_cfg.forced_bos_token_id is not None:

@@ -65,7 +65,9 @@ def suggest_layout(model: dict, n_devices: int, hbm_gb: float = 16.0) -> dict:
         return state / (deg["fsdp"] * deg["mp"] * deg["pp"]) <= budget
 
     def can_double(axis: str) -> bool:
-        if product() * 2 > n_devices:
+        # divisibility, not just capacity: on e.g. 24 devices fsdp must stop
+        # at 8 (leaving dp=3), not run to 16 and fail the final divmod
+        if n_devices % (product() * 2):
             return False
         if axis == "mp":
             return deg["mp"] < 8 and heads % (deg["mp"] * 2) == 0
@@ -83,7 +85,7 @@ def suggest_layout(model: dict, n_devices: int, hbm_gb: float = 16.0) -> dict:
             deg[axis] *= 2
 
     if seq_len >= 4096:
-        while deg["seq"] < 4 and product() * 2 <= n_devices and \
+        while deg["seq"] < 4 and n_devices % (product() * 2) == 0 and \
                 seq_len % (256 * deg["seq"] * 2) == 0:
             deg["seq"] *= 2
 

@@ -244,9 +244,13 @@ def get_config(fname: str, overrides: list[str] | None = None, show: bool = Fals
     config = parse_config(fname)
     override_config(config, overrides)
     dist = config.get("Distributed") or {}
-    if auto_layout or dist.get("auto_layout"):
+    al = dist.get("auto_layout")
+    if auto_layout or al:
         from fleetx_tpu.parallel.auto_layout import suggest_layout
 
+        # YAML can size the planner's budget: auto_layout: {hbm_gb: 32}
+        hbm_gb = float(al.get("hbm_gb", 16.0)) if isinstance(al, dict) \
+            else 16.0
         if num_devices is None:
             import jax
 
@@ -260,7 +264,7 @@ def get_config(fname: str, overrides: list[str] | None = None, show: bool = Fals
             logger.info("auto_layout: explicit degrees %s kept", explicit)
         else:
             layout = suggest_layout(dict(config.get("Model") or {}),
-                                    num_devices)
+                                    num_devices, hbm_gb=hbm_gb)
             config.setdefault("Distributed", AttrDict())
             for k, v in layout.items():
                 # merge (don't replace) the sharding sub-dict: the recipe

@@ -38,7 +38,9 @@ def main():
 
     text = gen_cfg.get("input_text", "The quick brown fox")
     if module.tokenizer is not None:
-        print(module.generate(params, [text], rng)[0])
+        # one line per returned sample (num_return_sequences may be > 1)
+        for continuation in module.generate(params, [text], rng):
+            print(continuation)
     else:
         prompts = [[int(t) for t in str(text).split()]] \
             if str(text).replace(" ", "").isdigit() else [[1, 2, 3]]

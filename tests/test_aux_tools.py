@@ -118,6 +118,11 @@ def test_auto_layout_planner():
     d = suggest_layout(long8k, 8)
     assert d["seq_degree"] >= 2 and product(d) == 8
 
+    # non-power-of-two device counts: axis growth must stop at divisors
+    # (fsdp runs to 8, dp takes the 3 — not a ValueError at 16)
+    d = suggest_layout(gpt67b, 24)
+    assert product(d) == 24 and d["fsdp_degree"] == 8 and d["dp_degree"] == 3
+
 
 def test_auto_layout_flows_through_get_config(tmp_path):
     """tools/auto.py path: Distributed.auto_layout triggers the planner

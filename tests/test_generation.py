@@ -151,6 +151,44 @@ def test_repetition_penalty_covers_prompt_tokens(small_model):
     assert late[0, 1] == 0.0
 
 
+def test_num_return_sequences_expands_rows(small_model):
+    """Reference num_return_sequences: each prompt sampled n times
+    independently; rows come back prompt-major [b*n, new_tokens]."""
+    model, params, cfg = small_model
+    gen_cfg = G.GenerationConfig(max_new_tokens=4, do_sample=True,
+                                 temperature=2.0, num_return_sequences=3,
+                                 eos_token_id=96, pad_token_id=0)
+    prompts = [[5, 6, 7], [9, 10]]
+    tokens, mask = G.left_pad(prompts, 0)
+    out = G.generate(model, params, gen_cfg, jnp.asarray(tokens),
+                     jnp.asarray(mask), jax.random.PRNGKey(1))
+    assert out.shape == (6, 4)
+    # independent draws: the three returns for a prompt are not all equal
+    rows = np.asarray(out)
+    assert not (np.all(rows[0] == rows[1]) and np.all(rows[1] == rows[2]))
+
+    # greedy via decode_strategy must collapse to identical rows
+    from fleetx_tpu.core.module import GPTGenerationModule
+    m = GPTGenerationModule({"Model": dict(vocab_size=97, hidden_size=64,
+                                           num_layers=2,
+                                           num_attention_heads=4,
+                                           max_position_embeddings=64,
+                                           dtype="float32",
+                                           param_dtype="float32"),
+                             "Generation": {"decode_strategy": "greedy_search",
+                                            "num_return_sequences": 2,
+                                            "max_dec_len": 4,
+                                            "eos_token_id": 96,
+                                            "pad_token_id": 0}})
+    assert m.gen_cfg.do_sample is False
+    out2 = G.generate(model, params, m.gen_cfg, jnp.asarray(tokens),
+                      jnp.asarray(mask), jax.random.PRNGKey(0))
+    rows2 = np.asarray(out2)
+    assert rows2.shape == (4, 4)
+    np.testing.assert_array_equal(rows2[0], rows2[1])
+    np.testing.assert_array_equal(rows2[2], rows2[3])
+
+
 def test_eos_stops_and_pads(small_model):
     model, params, cfg = small_model
     # force eos immediately via min_new_tokens=0 and forced bos = eos

@@ -10,7 +10,8 @@ Writes the AOT artifact (serialized StableHLO + params) described in
 ``./exported``). Targets:
 
 - ``forward``   — logits fn ``(params, tokens, position_ids) → [b,s,vocab]``
-- ``generation``— decode fn ``(params, tokens, mask, rng) → [b, new_tokens]``
+- ``generation``— decode fn ``(params, tokens, mask, rng) →
+  [b * num_return_sequences, new_tokens]`` (prompt-major rows)
   (picked automatically when the config has a ``Generation`` section)
 """
 
