@@ -1,0 +1,337 @@
+"""Opportunistic on-chip benchmark capture (VERDICT r4 task #1a).
+
+The TPU tunnel in this environment is down for hours at a time; four driver
+rounds in a row ended with a dead tunnel exactly during the driver's bench
+window, leaving the repo with no auditable on-chip number. This watcher
+closes that hole: it loops for the whole round, probes backend liveness
+every few minutes, and on the FIRST healthy window runs the full capture
+suite, committing permanent artifacts:
+
+- ``BENCH_SELF.json``            — all captured metrics + timestamps
+- ``bench_artifacts/*.{out,err}.log`` — raw child stdout/stderr (audit trail)
+- ``bench_artifacts/trace_gpt.tar.gz`` — a ``jax.profiler`` trace of the
+  benched GPT-345M step
+
+Capture suite (each a fresh subprocess, probe-gated, OOM-fallback):
+
+1. ``gpt``        — canonical GPT-345M bs8xseq1024 bench (bench.py child)
+2. ``gpt_trace``  — same config under ``jax.profiler.trace``
+3. ``vit``        — ViT-L/16 images/sec (fallback ViT-B) — north-star #2
+4. ``gpt_seq2048``— seq-2048 variant (per-step overhead amortisation)
+5. ``gpt_bs16_vc``— bs16 + vocab_chunk (the round-4 regression config)
+6. ``losscurve``  — 300-step run on the real tokenized corpus (if built)
+
+Partial captures are committed too (a window can die mid-suite); remaining
+steps retry on the next healthy window. Exit 0 once everything (or at
+minimum the canonical ``gpt`` number) is captured and committed.
+
+Run detached:  ``nohup python tools/tpu_watch.py > /dev/null 2>&1 &``
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+ART = os.path.join(_REPO, "bench_artifacts")
+STATE = os.path.join(ART, "state.json")
+LOG = os.path.join(ART, "watch.log")
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+def log(msg: str) -> None:
+    os.makedirs(ART, exist_ok=True)
+    line = f"[{_now()}] {msg}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+# reuse the hardened tunnel logic from the driver bench — one implementation
+# of probing / cache env / error classification to keep in sync
+from bench import _cache_env as _bench_cache_env  # noqa: E402
+from bench import DRIVER_FLAG, _ERROR_CLASSES, _classify, _probe  # noqa: E402
+
+
+def driver_active(max_age_s: float = 2700.0) -> bool:
+    """True while the driver's own bench.py run holds the chip (flag file
+    fresher than its 45-min budget; stale flags from killed runs expire)."""
+    try:
+        return time.time() - os.path.getmtime(DRIVER_FLAG) < max_age_s
+    except OSError:
+        return False
+
+
+def _cache_env(extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env.update(_bench_cache_env())
+    env.update(extra or {})
+    return env
+
+
+def probe(timeout: float = 90.0) -> str:
+    """'ok' | 'cpu-only' | error class, via bench.py's probe subprocess."""
+    return _probe(timeout)
+
+
+def run_child(name: str, argv: list[str], env_extra: dict,
+              timeout: float = 1200.0):
+    """Run one capture child; persist raw stdout/stderr; return (json, err).
+
+    ``err`` is an error CLASS (e.g. ``RESOURCE_EXHAUSTED``) derived from the
+    whole stderr, not just its last line — JAX OOMs end with a multi-line
+    allocation table, so last-line matching misclassifies them.
+    Log files are timestamped per attempt so retries/fallbacks never clobber
+    earlier evidence (they are the audit trail).
+    """
+    env = _cache_env(env_extra)
+    env["FLEETX_BENCH_CHILD"] = "1"
+    t0 = time.monotonic()
+    timed_out = False
+    try:
+        p = subprocess.run(argv, env=env, timeout=timeout,
+                           capture_output=True, text=True, cwd=_REPO)
+        out, err_txt, rc = p.stdout, p.stderr, p.returncode
+    except subprocess.TimeoutExpired as e:
+        def _dec(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
+        # keep the hung child's partial diagnostics in the audit log
+        out, err_txt, rc, timed_out = _dec(e.stdout), _dec(e.stderr), -1, True
+    dt = time.monotonic() - t0
+    os.makedirs(ART, exist_ok=True)
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%H%M%S")
+    with open(os.path.join(ART, f"{name}.{stamp}.out.log"), "w") as f:
+        f.write(f"# captured_at={_now()} wall={dt:.1f}s rc={rc}\n# argv={argv}\n"
+                f"# env_extra={env_extra}\n{out}")
+    with open(os.path.join(ART, f"{name}.{stamp}.err.log"), "w") as f:
+        f.write(err_txt)
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            result = json.loads(line)
+            result["captured_at"] = _now()
+            result["wall_s"] = round(dt, 1)
+            return result, None
+        except json.JSONDecodeError:
+            continue
+    err_cls = _classify(err_txt or "no output")
+    if timed_out and err_cls not in _ERROR_CLASSES:
+        err_cls = "timeout"
+    return None, err_cls
+
+
+def _load_state() -> dict:
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            return json.load(f)
+    return {}
+
+
+def _save_state(state: dict) -> None:
+    os.makedirs(ART, exist_ok=True)
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, STATE)
+
+
+def _is_oom(err: str | None) -> bool:
+    return bool(err) and "RESOURCE_EXHAUSTED" in err
+
+
+def _capture_gpt(state: dict) -> None:
+    for gran in ("dots", "full"):
+        res, err = run_child(f"gpt_{gran}", [sys.executable, "bench.py"],
+                             {"FLEETX_BENCH_RECOMPUTE": gran})
+        if res and res.get("device_kind") != "cpu":
+            res["recompute"] = gran
+            state["gpt"] = res
+            return
+        log(f"gpt[{gran}] failed: {err or 'cpu fallback'}")
+        if not _is_oom(err):
+            return
+
+
+def _capture_gpt_trace(state: dict) -> None:
+    import shutil
+
+    trace_dir = os.path.join(ART, "trace_gpt")
+    # a fresh dir per attempt: an aborted earlier session must not end up in
+    # the committed tarball mixed with the session that backs the number
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    gran = (state.get("gpt") or {}).get("recompute", "dots")
+    res, err = run_child("gpt_trace", [sys.executable, "bench.py"],
+                         {"FLEETX_BENCH_RECOMPUTE": gran,
+                          "FLEETX_BENCH_TRACE": trace_dir})
+    if res and res.get("device_kind") != "cpu" and os.path.isdir(trace_dir):
+        tar_path = os.path.join(ART, "trace_gpt.tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tar:
+            tar.add(trace_dir, arcname="trace_gpt")
+        res["trace"] = "bench_artifacts/trace_gpt.tar.gz"
+        state["gpt_trace"] = res
+    else:
+        log(f"gpt_trace failed: {err or 'cpu fallback'}")
+
+
+def _capture_vit(state: dict) -> None:
+    for name, bs in (("ViT_large_patch16_224", 128),
+                     ("ViT_large_patch16_224", 64),
+                     ("ViT_base_patch16_224", 256),
+                     ("ViT_base_patch16_224", 128)):
+        res, err = run_child(f"vit_{name}_bs{bs}",
+                             [sys.executable, "tools/bench_vit.py"],
+                             {"FLEETX_VIT_NAME": name,
+                              "FLEETX_VIT_BS": str(bs)})
+        if res and res.get("device_kind") != "cpu":
+            state["vit"] = res
+            return
+        log(f"vit[{name} bs{bs}] failed: {err or 'cpu fallback'}")
+        if not _is_oom(err):
+            return
+
+
+def _capture_gpt_seq2048(state: dict) -> None:
+    res, err = run_child("gpt_seq2048", [sys.executable, "bench.py"],
+                         {"FLEETX_BENCH_RECOMPUTE": "dots",
+                          "FLEETX_BENCH_SEQ": "2048",
+                          "FLEETX_BENCH_BS": "4"})
+    if res and res.get("device_kind") != "cpu":
+        state["gpt_seq2048"] = res
+    else:
+        log(f"gpt_seq2048 failed: {err or 'cpu fallback'}")
+
+
+def _capture_gpt_bs16_vc(state: dict) -> None:
+    res, err = run_child("gpt_bs16_vc", [sys.executable, "bench.py"],
+                         {"FLEETX_BENCH_RECOMPUTE": "dots",
+                          "FLEETX_BENCH_BS": "16",
+                          "FLEETX_BENCH_VOCAB_CHUNK": "8192"})
+    if res and res.get("device_kind") != "cpu":
+        state["gpt_bs16_vc"] = res
+    else:
+        log(f"gpt_bs16_vc failed: {err or 'cpu fallback'}")
+
+
+def _capture_losscurve(state: dict) -> None:
+    script = os.path.join(_REPO, "tools", "bench_losscurve.py")
+    if not os.path.exists(script):
+        state["losscurve"] = {"skipped": "tools/bench_losscurve.py not built yet"}
+        return
+    res, err = run_child("losscurve", [sys.executable, script], {},
+                         timeout=1800.0)
+    if res and res.get("device_kind") != "cpu":
+        state["losscurve"] = res
+    else:
+        log(f"losscurve failed: {err or 'cpu fallback'}")
+
+
+CAPTURES = [
+    ("gpt", _capture_gpt),
+    ("gpt_trace", _capture_gpt_trace),
+    ("vit", _capture_vit),
+    ("gpt_seq2048", _capture_gpt_seq2048),
+    ("gpt_bs16_vc", _capture_gpt_bs16_vc),
+    ("losscurve", _capture_losscurve),
+]
+
+
+def _git(args: list[str]) -> subprocess.CompletedProcess:
+    return subprocess.run(["git"] + args, cwd=_REPO,
+                          capture_output=True, text=True)
+
+
+def commit_artifacts(state: dict) -> None:
+    bench_self = os.path.join(_REPO, "BENCH_SELF.json")
+    payload = {
+        "written_at": _now(),
+        "device_kind": (state.get("gpt") or {}).get("device_kind"),
+        "results": state,
+        "raw_logs": sorted(p for p in os.listdir(ART) if p.endswith(".log")),
+    }
+    with open(bench_self, "w") as f:
+        json.dump(payload, f, indent=1)
+    # commit only our own paths so a concurrent interactive commit can't be
+    # clobbered; retry around transient index.lock contention
+    for attempt in range(5):
+        _git(["add", "-A", "--", "bench_artifacts", "BENCH_SELF.json"])
+        # never commit the raw (untarred) trace directory
+        _git(["reset", "-q", "--", "bench_artifacts/trace_gpt"])
+        done = [k for k, v in state.items() if v and "skipped" not in v]
+        r = _git(["commit",
+                  "-m", f"Capture on-chip benchmark artifacts ({', '.join(done)})",
+                  "--", "bench_artifacts", "BENCH_SELF.json"])
+        if r.returncode == 0 or "nothing to commit" in r.stdout + r.stderr:
+            log(f"committed artifacts: {r.stdout.strip().splitlines()[:1]}")
+            return
+        log(f"git commit failed (attempt {attempt}): {(r.stderr or r.stdout)[-200:]}")
+        time.sleep(15)
+
+
+def main() -> int:
+    budget = float(os.environ.get("FLEETX_WATCH_BUDGET", 37800.0))
+    interval = float(os.environ.get("FLEETX_WATCH_INTERVAL", 240.0))
+    t0 = time.monotonic()
+    state = _load_state()
+    cpu_only_streak = 0
+    log(f"watcher start: budget={budget:.0f}s, pending="
+        f"{[k for k, _ in CAPTURES if k not in state]}")
+    while time.monotonic() - t0 < budget:
+        pending = [(k, fn) for k, fn in CAPTURES if not state.get(k)]
+        if not pending:
+            log("all captures done")
+            return 0
+        if driver_active():
+            # the driver's own bench.py holds the single-tenant chip —
+            # yield the window rather than racing it for backend init
+            log("driver bench active; yielding")
+            time.sleep(interval)
+            continue
+        status = probe()
+        if status == "cpu-only":
+            # permanent condition (no accelerator plugin registered) — a dead
+            # tunnel shows up as timeout/UNAVAILABLE, never as cpu-only
+            cpu_only_streak += 1
+            log(f"probe: cpu-only ({cpu_only_streak}/3)")
+            if cpu_only_streak >= 3:
+                log("no accelerator plugin; giving up")
+                return 3
+            time.sleep(interval)
+            continue
+        cpu_only_streak = 0
+        if status != "ok":
+            log(f"probe: {status}")
+            time.sleep(interval)
+            continue
+        log(f"healthy window! capturing: {[k for k, _ in pending]}")
+        for name, fn in pending:
+            # the tunnel dies mid-suite in this environment: a 90s re-probe
+            # before each expensive child beats burning 1200s timeouts
+            if name != pending[0][0] and (driver_active() or probe() != "ok"):
+                log("tunnel died or driver took over mid-suite; back to probe loop")
+                break
+            fn(state)
+            _save_state(state)
+            if name == "gpt" and not state.get("gpt"):
+                break  # canonical capture failed — re-probe before burning more
+        if state.get("gpt"):
+            commit_artifacts(state)
+        if all(state.get(k) for k, _ in CAPTURES):
+            log("capture suite complete")
+            return 0
+        time.sleep(30)
+    log("budget exhausted")
+    return 3 if not state.get("gpt") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
