@@ -195,16 +195,18 @@ def _bench_impl() -> dict:
 
     tokens_per_s = bsz * seq / dt
     name = "gpt345m" if not scaled else f"gpt{layers}l_scaled"
-    if not scaled and (bsz != 8 or seq != 1024 or VOCAB_CHUNK):
+    variant = not scaled and (bsz != DEFAULT_BATCH or seq != DEFAULT_SEQ
+                              or bool(VOCAB_CHUNK))
+    if variant:
         name += f"_bs{bsz}_seq{seq}" + (f"_vc{VOCAB_CHUNK}" if VOCAB_CHUNK else "")
     result = {
         "metric": f"{name}_train_tokens_per_s_{platform}",
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
-        # the baseline bar is the full 345M recipe — a scaled cpu run is
-        # recorded but not comparable
+        # the baseline bar is defined ONLY for the bs8/seq1024 345M recipe —
+        # scaled cpu runs and variant sweeps are recorded but not comparable
         "vs_baseline": (round(tokens_per_s / BASELINE_TOKENS_PER_S, 3)
-                        if not scaled else 0.0),
+                        if not scaled and not variant else 0.0),
         "step_time_s": round(dt, 4),
         "batch_size": bsz,
         "loss": round(loss, 3),
@@ -309,6 +311,7 @@ def main():
     granularity = "dots"  # fastest policy that fits; "full" after an OOM
     dots_failures = 0
     while remaining() > cpu_reserve + 180.0:
+        _touch_driver_flag()  # keep the claim fresh across long retry cycles
         status = _probe(min(90.0, remaining() - cpu_reserve - 120.0))
         if status == "cpu-only":
             # permanent condition (no accelerator plugin) — don't burn the

@@ -41,6 +41,24 @@ def test_bpe_unicode_bytes():
     assert tok.decode(tok.encode(text)) == text
 
 
+def test_bpe_incremental_matches_naive_spec():
+    """train_bpe (incremental, heap-based) must be bit-identical to the
+    naive full-recount trainer — same vocab, same merge order."""
+    from fleetx_tpu.data.tokenizers.gpt_tokenizer import _train_bpe_naive
+
+    corpora = [
+        CORPUS,
+        ["aaa aaab aab abab babab " * 5, "ccc aaa bbb " * 3],
+        ["naïve café — ünïcödé tëst", "日本語 mixed 中文 text 42!"],
+    ]
+    for texts in corpora:
+        for vocab_size in (270, 320, 420):
+            naive = _train_bpe_naive(texts, vocab_size)
+            fast = train_bpe(texts, vocab_size)
+            assert fast.encoder == naive.encoder
+            assert fast.bpe_ranks == naive.bpe_ranks
+
+
 # --------------------------------------------------------------- dataset
 
 

@@ -1,0 +1,172 @@
+"""Build a real (non-synthetic) English training corpus from in-image text.
+
+The reference's de-facto integration test is training on real data and
+comparing the published loss curve (``/root/reference/docs/quick_start.md:
+110-116``). Its 300M-token demo set is a download — unavailable here (zero
+egress) — so this tool assembles the largest real English corpus the image
+contains: package documentation, changelogs, licenses, and README/markdown/
+rst prose from ``/usr/share/doc`` and site-packages. That is genuine
+natural-language text with learnable long-range structure (vs the synthetic
+random tokens every previous round trained on).
+
+Pipeline (all offline):
+
+    python tools/make_corpus.py --out-dir data_cache \
+        --vocab-size 16384 --train-frac-mb 8
+
+1. walk the source trees, decompress ``.gz``, strip binary/control chars,
+   dedupe by content hash, emit one document per file → ``corpus.jsonl``
+2. train a byte-level BPE tokenizer (incremental trainer) on a slice
+   → ``tokenizer/``
+3. tokenize the full corpus via tools/preprocess_data.py
+   → ``real_corpus_ids.npy`` + ``real_corpus_idx.npz`` (GPTDataset format)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SOURCE_GLOBS = [
+    "/usr/share/doc/**/*",
+    "/usr/share/common-licenses/*",
+    "/opt/venv/lib/python3.12/site-packages/**/*.md",
+    "/opt/venv/lib/python3.12/site-packages/**/*.rst",
+    "/opt/venv/lib/python3.12/site-packages/**/LICENSE*",
+    "/opt/venv/lib/python3.12/site-packages/**/*.txt",
+]
+
+# a bounded slice of Python source — real pretraining mixes include code,
+# and it roughly doubles the available token count
+CODE_GLOBS = [
+    "/usr/lib/python3.12/**/*.py",
+    "/opt/venv/lib/python3.12/site-packages/numpy/**/*.py",
+    "/opt/venv/lib/python3.12/site-packages/jax/**/*.py",
+    "/opt/venv/lib/python3.12/site-packages/flax/**/*.py",
+    "/opt/venv/lib/python3.12/site-packages/transformers/**/*.py",
+]
+CODE_BUDGET_BYTES = 15_000_000
+
+# skip obviously non-prose text assets (word lists, unicode tables, data)
+SKIP_SUBSTRINGS = ("sacremoses", "jieba", "unichars", "requirements",
+                   "RECORD", "entry_points", "top_level", "INSTALLER")
+
+
+def _printable_ratio(text: str) -> float:
+    if not text:
+        return 0.0
+    good = sum(1 for c in text[:4000] if c.isprintable() or c in "\n\t ")
+    return good / min(len(text), 4000)
+
+
+def _read_text(path: str) -> str | None:
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+                return f.read(8_000_000)
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            return f.read(8_000_000)
+    except (OSError, EOFError):
+        return None
+
+
+def collect_documents(min_chars: int = 400) -> list[str]:
+    seen_hashes: set[bytes] = set()
+    docs: list[str] = []
+    paths: list[str] = []
+    for pattern in SOURCE_GLOBS:
+        paths.extend(glob.glob(pattern, recursive=True))
+    for path in sorted(set(paths)):
+        if not os.path.isfile(path):
+            continue
+        if any(s in path for s in SKIP_SUBSTRINGS):
+            continue
+        if path.endswith((".png", ".jpg", ".svg", ".mo", ".pdf", ".html",
+                          ".css", ".js", ".json", ".yaml", ".xml")):
+            continue
+        text = _read_text(path)
+        if text is None or len(text) < min_chars:
+            continue
+        if _printable_ratio(text) < 0.97:
+            continue
+        digest = hashlib.sha1(text.encode("utf-8", "replace")).digest()
+        if digest in seen_hashes:  # many packages ship identical licenses
+            continue
+        seen_hashes.add(digest)
+        docs.append(text)
+    code_paths: list[str] = []
+    for pattern in CODE_GLOBS:
+        code_paths.extend(glob.glob(pattern, recursive=True))
+    used = 0
+    for path in sorted(set(code_paths)):
+        if used >= CODE_BUDGET_BYTES or not os.path.isfile(path):
+            continue
+        text = _read_text(path)
+        if text is None or len(text) < min_chars:
+            continue
+        digest = hashlib.sha1(text.encode("utf-8", "replace")).digest()
+        if digest in seen_hashes:
+            continue
+        seen_hashes.add(digest)
+        docs.append(text[:100_000])
+        used += min(len(text), 100_000)
+    return docs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default=os.path.join(_REPO, "data_cache"))
+    ap.add_argument("--vocab-size", type=int, default=16384)
+    ap.add_argument("--train-frac-mb", type=float, default=8.0,
+                    help="MB of text the BPE trainer sees (speed knob)")
+    ap.add_argument("--workers", type=int, default=8)
+    args = ap.parse_args()
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    docs = collect_documents()
+    total_mb = sum(len(d) for d in docs) / 1e6
+    print(f"collected {len(docs)} unique documents, {total_mb:.1f}MB text")
+
+    jsonl = os.path.join(args.out_dir, "corpus.jsonl")
+    with open(jsonl, "w") as f:
+        for d in docs:
+            f.write(json.dumps({"text": d}) + "\n")
+
+    tok_dir = os.path.join(args.out_dir, "tokenizer")
+    if not os.path.exists(os.path.join(tok_dir, "vocab.json")):
+        from fleetx_tpu.data.tokenizers.gpt_tokenizer import train_bpe
+
+        budget = int(args.train_frac_mb * 1e6)
+        sample, used = [], 0
+        for d in docs:  # spread the budget across documents
+            take = d[:200_000]
+            sample.append(take)
+            used += len(take)
+            if used >= budget:
+                break
+        print(f"training {args.vocab_size}-token BPE on {used/1e6:.1f}MB ...")
+        tok = train_bpe(sample, vocab_size=args.vocab_size)
+        tok.save_pretrained(tok_dir)
+        print(f"tokenizer saved to {tok_dir}")
+
+    prefix = os.path.join(args.out_dir, "real_corpus")
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "preprocess_data.py"),
+           "--input", jsonl, "--json-key", "text",
+           "--tokenizer", tok_dir, "--output-prefix", prefix,
+           "--workers", str(args.workers), "--append-eos"]
+    print("tokenizing full corpus ...")
+    subprocess.run(cmd, check=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,7 +85,10 @@ def main(argv=None):
     p.add_argument("--output-prefix", required=True)
     p.add_argument("--workers", type=int, default=max(os.cpu_count() // 2, 1))
     p.add_argument("--append-eos", action="store_true")
-    p.add_argument("--eos-id", type=int, default=50256)
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="document separator id; defaults to the tokenizer's "
+                        "own eos id (an explicit 50256 with a smaller custom "
+                        "vocab would inject out-of-range tokens)")
     p.add_argument("--log-interval", type=int, default=10000)
     args = p.parse_args(argv)
 
@@ -95,7 +98,13 @@ def main(argv=None):
     chunks: list[np.ndarray] = []
     lens: list[int] = []
     total_tokens = 0
-    worker_args = {"append_eos": args.append_eos, "eos_id": args.eos_id}
+    eos_id = args.eos_id
+    if eos_id is None:
+        from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+        eos_id = GPTTokenizer.from_pretrained(args.tokenizer).eos_token_id
+        logger.info("using tokenizer eos id %d as document separator", eos_id)
+    worker_args = {"append_eos": args.append_eos, "eos_id": eos_id}
 
     with multiprocessing.Pool(
             args.workers, initializer=_init_worker,

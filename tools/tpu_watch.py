@@ -119,11 +119,13 @@ def run_child(name: str, argv: list[str], env_extra: dict,
     for line in reversed((out or "").strip().splitlines()):
         try:
             result = json.loads(line)
-            result["captured_at"] = _now()
-            result["wall_s"] = round(dt, 1)
-            return result, None
         except json.JSONDecodeError:
             continue
+        if not isinstance(result, dict):  # stray scalar prints are not results
+            continue
+        result["captured_at"] = _now()
+        result["wall_s"] = round(dt, 1)
+        return result, None
     err_cls = _classify(err_txt or "no output")
     if timed_out and err_cls not in _ERROR_CLASSES:
         err_cls = "timeout"
@@ -224,12 +226,14 @@ def _capture_gpt_bs16_vc(state: dict) -> None:
 
 def _capture_losscurve(state: dict) -> None:
     script = os.path.join(_REPO, "tools", "bench_losscurve.py")
-    if not os.path.exists(script):
-        state["losscurve"] = {"skipped": "tools/bench_losscurve.py not built yet"}
+    corpus = os.path.join(_REPO, "data_cache", "real_corpus_ids.npy")
+    if not (os.path.exists(script) and os.path.exists(corpus)):
+        # leave state unset so the capture retries once the corpus exists
+        log("losscurve prerequisites missing; will retry next window")
         return
     res, err = run_child("losscurve", [sys.executable, script], {},
                          timeout=1800.0)
-    if res and res.get("device_kind") != "cpu":
+    if res and res.get("device_kind") and res.get("device_kind") != "cpu":
         state["losscurve"] = res
     else:
         log(f"losscurve failed: {err or 'cpu fallback'}")
