@@ -171,6 +171,34 @@ class GPTTokenizer:
         return self.encode(text)
 
 
+def _count_words(texts) -> dict:
+    """Pretokenize + byte-map ``texts`` into word -> count (shared by both
+    BPE trainers, which must stay bit-identical)."""
+    byte_encoder = bytes_to_unicode()
+    word_counts: dict[tuple[str, ...], int] = {}
+    for text in texts:
+        for tok in PRETOKENIZE_PAT.findall(text):
+            mapped = tuple(byte_encoder[b] for b in tok.encode("utf-8"))
+            if mapped:
+                word_counts[mapped] = word_counts.get(mapped, 0) + 1
+    return word_counts
+
+
+def _apply_merge(word: tuple, best: tuple, merged: str) -> tuple:
+    """Rewrite ``word`` with every (non-overlapping, left-to-right)
+    occurrence of pair ``best`` fused into ``merged``."""
+    out: list[str] = []
+    i = 0
+    while i < len(word):
+        if i < len(word) - 1 and (word[i], word[i + 1]) == best:
+            out.append(merged)
+            i += 2
+        else:
+            out.append(word[i])
+            i += 1
+    return tuple(out)
+
+
 def _train_bpe_naive(texts, vocab_size: int, eos_token: str = "<|endoftext|>"):
     """Naive BPE trainer: full pair recount per merge, O(merges x words).
 
@@ -179,19 +207,11 @@ def _train_bpe_naive(texts, vocab_size: int, eos_token: str = "<|endoftext|>"):
     ``tests/test_data.py``); use ``train_bpe`` for anything bigger than a
     test corpus.
     """
-    byte_encoder = bytes_to_unicode()
-    word_counts: dict[tuple[str, ...], int] = {}
-    for text in texts:
-        for tok in PRETOKENIZE_PAT.findall(text):
-            mapped = tuple(byte_encoder[b] for b in tok.encode("utf-8"))
-            if mapped:
-                word_counts[mapped] = word_counts.get(mapped, 0) + 1
-
-    alphabet = sorted(byte_encoder.values())
+    alphabet = sorted(bytes_to_unicode().values())
     vocab = {ch: i for i, ch in enumerate(alphabet)}
     merges: list[tuple[str, str]] = []
 
-    words = dict(word_counts)
+    words = _count_words(texts)
     while len(vocab) < vocab_size - 1:  # -1 reserves the eos slot
         pair_counts: dict[tuple[str, str], int] = {}
         for word, cnt in words.items():
@@ -205,16 +225,8 @@ def _train_bpe_naive(texts, vocab_size: int, eos_token: str = "<|endoftext|>"):
         vocab[merged] = len(vocab)
         new_words = {}
         for word, cnt in words.items():
-            out: list[str] = []
-            i = 0
-            while i < len(word):
-                if i < len(word) - 1 and (word[i], word[i + 1]) == best:
-                    out.append(merged)
-                    i += 2
-                else:
-                    out.append(word[i])
-                    i += 1
-            new_words[tuple(out)] = new_words.get(tuple(out), 0) + cnt
+            out = _apply_merge(word, best, merged)
+            new_words[out] = new_words.get(out, 0) + cnt
         words = new_words
 
     return GPTTokenizer(vocab, merges, eos_token=eos_token)
@@ -241,19 +253,11 @@ def train_bpe(texts, vocab_size: int, eos_token: str = "<|endoftext|>"):
     """
     import heapq
 
-    byte_encoder = bytes_to_unicode()
-    word_counts: dict[tuple[str, ...], int] = {}
-    for text in texts:
-        for tok in PRETOKENIZE_PAT.findall(text):
-            mapped = tuple(byte_encoder[b] for b in tok.encode("utf-8"))
-            if mapped:
-                word_counts[mapped] = word_counts.get(mapped, 0) + 1
-
-    alphabet = sorted(byte_encoder.values())
+    alphabet = sorted(bytes_to_unicode().values())
     vocab = {ch: i for i, ch in enumerate(alphabet)}
     merges: list[tuple[str, str]] = []
 
-    words = dict(word_counts)
+    words = _count_words(texts)
     pair_counts: dict[tuple[str, str], int] = {}
     # pair -> set of words currently containing it (occurrence index)
     where: dict[tuple[str, str], set] = {}
@@ -290,16 +294,7 @@ def train_bpe(texts, vocab_size: int, eos_token: str = "<|endoftext|>"):
             cnt = words.pop(word, 0)
             if cnt == 0:
                 continue
-            out: list[str] = []
-            i = 0
-            while i < len(word):
-                if i < len(word) - 1 and (word[i], word[i + 1]) == best:
-                    out.append(merged)
-                    i += 2
-                else:
-                    out.append(word[i])
-                    i += 1
-            changed.append((word, tuple(out), cnt))
+            changed.append((word, _apply_merge(word, best, merged), cnt))
 
         touched: set = set()
         for old, new, cnt in changed:

@@ -185,7 +185,8 @@ class ErnieModel(nn.Module):
         layer = ErnieEncoderLayer
         if cfg.use_recompute:
             layer = nn.remat(layer, prevent_cse=False,
-                             policy=jax.checkpoint_policies.nothing_saveable)
+                             policy=jax.checkpoint_policies.nothing_saveable,
+                             static_argnums=(3,))
         if cfg.scan_layers:
             stack = nn.scan(layer, variable_axes={"params": 0},
                             split_rngs={"params": True, "dropout": True},

@@ -208,8 +208,11 @@ class ViT(nn.Module):
 
         block = ViTBlock
         if cfg.use_recompute:
+            # deterministic is a control flag, not data — static under remat
+            # (traced it breaks `if deterministic` in DropPath/Dropout)
             block = nn.remat(block, prevent_cse=False,
-                             policy=jax.checkpoint_policies.nothing_saveable)
+                             policy=jax.checkpoint_policies.nothing_saveable,
+                             static_argnums=(2,))
         if cfg.scan_layers:
             stack = nn.scan(block, variable_axes={"params": 0},
                             split_rngs={"params": True, "dropout": True},

@@ -161,3 +161,22 @@ def test_reference_yaml_op_chain_with_tochw():
     params = module.init_variables(jax.random.PRNGKey(0), batch)
     loss, _ = module.training_loss(params, batch, jax.random.PRNGKey(1), 0)
     assert np.isfinite(float(loss))
+
+
+def test_recompute_with_droppath_trains():
+    """Regression: nn.remat must keep `deterministic` static (VERDICT r5 —
+    the on-chip ViT bench uses use_recompute + drop_path and hit a
+    TracerBoolConversionError in DropPath before the static_argnums fix)."""
+    cfg = tiny_vit_cfg(use_recompute=True, drop_path_rate=0.1, drop_rate=0.1)
+    model = ViT(cfg)
+    imgs = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    from flax.core import meta
+    params = meta.unbox(
+        model.init({"params": jax.random.PRNGKey(0)}, imgs, True)["params"])
+
+    def loss(p, x):
+        return model.apply({"params": p}, x, False,
+                           rngs={"dropout": jax.random.PRNGKey(1)}).sum()
+
+    g = jax.jit(jax.grad(loss))(params, imgs)
+    assert all(np.isfinite(x).all() for x in jax.tree.leaves(g))

@@ -50,12 +50,31 @@ def main() -> int:
     from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
     from fleetx_tpu.optims.optimizer import build_optimizer
 
-    # tokenizer vocab is 16384 (make_corpus default); keep the model's padded
-    # 50304 table on TPU so the run matches the benched 345M architecture
-    vocab = 50304 if not scaled else 16384
-    # train_bpe reserves the last slot for <|endoftext|> (16383 for the
-    # default make_corpus vocab); overridable for other corpora
-    eos_id = int(os.environ.get("FLEETX_LOSSCURVE_EOS", 16383))
+    # derive eos/vocab from the corpus's own tokenizer (make_corpus saves it
+    # next to the ids); a hardcoded id either never matches (separators go
+    # unmasked) or exceeds smaller vocabs' embedding tables (silent clamping)
+    eos_env = os.environ.get("FLEETX_LOSSCURVE_EOS")
+    tok_dir = os.path.join(os.path.dirname(prefix), "tokenizer")
+    if eos_env is not None:
+        # eos need not be the top id (e.g. Llama-style eos=2) — size the
+        # table from the corpus ids themselves, not from the eos id
+        ids = np.load(prefix + "_ids.npy", mmap_mode="r")
+        eos_id = int(eos_env)
+        tok_vocab = max(eos_id, int(ids.max())) + 1
+    elif os.path.exists(os.path.join(tok_dir, "vocab.json")):
+        with open(os.path.join(tok_dir, "vocab.json")) as f:
+            tok_vocab = len(json.load(f))
+        eos_id = tok_vocab - 1  # train_bpe reserves the last slot for eos
+    else:
+        # no tokenizer alongside the corpus: the ids themselves bound the
+        # vocab, and --append-eos guarantees eos (the top slot) occurs
+        ids = np.load(prefix + "_ids.npy", mmap_mode="r")
+        eos_id = int(ids.max())
+        tok_vocab = eos_id + 1
+    # model table must cover every corpus id; keep the benched 345M padded
+    # table (50304) on TPU when the tokenizer fits under it
+    pad128 = -(-tok_vocab // 128) * 128
+    vocab = max(50304, pad128) if not scaled else pad128
     cfg = {
         "Model": dict(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
                       num_attention_heads=heads,

@@ -142,7 +142,20 @@ def main() -> int:
             f.write(json.dumps({"text": d}) + "\n")
 
     tok_dir = os.path.join(args.out_dir, "tokenizer")
-    if not os.path.exists(os.path.join(tok_dir, "vocab.json")):
+    meta_path = os.path.join(tok_dir, "train_meta.json")
+    # cache key is the REQUESTED size (recorded at train time), not the saved
+    # vocab length — BPE can legitimately stop short when merges exhaust, and
+    # the undersized result is still the correct output for that request
+    cached_req = None
+    if os.path.exists(os.path.join(tok_dir, "vocab.json")):
+        cached_req = -1  # pre-meta cache: treat as unknown, retrain
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                cached_req = json.load(f).get("requested_vocab_size")
+        if cached_req != args.vocab_size:
+            print(f"cached tokenizer was trained for vocab {cached_req} != "
+                  f"requested {args.vocab_size}; retraining")
+    if cached_req != args.vocab_size:
         from fleetx_tpu.data.tokenizers.gpt_tokenizer import train_bpe
 
         budget = int(args.train_frac_mb * 1e6)
@@ -156,6 +169,8 @@ def main() -> int:
         print(f"training {args.vocab_size}-token BPE on {used/1e6:.1f}MB ...")
         tok = train_bpe(sample, vocab_size=args.vocab_size)
         tok.save_pretrained(tok_dir)
+        with open(meta_path, "w") as f:
+            json.dump({"requested_vocab_size": args.vocab_size}, f)
         print(f"tokenizer saved to {tok_dir}")
 
     prefix = os.path.join(args.out_dir, "real_corpus")

@@ -224,12 +224,27 @@ def _capture_gpt_bs16_vc(state: dict) -> None:
         log(f"gpt_bs16_vc failed: {err or 'cpu fallback'}")
 
 
+_LOSSCURVE_FIRST_MISS: float | None = None
+
+
 def _capture_losscurve(state: dict) -> None:
     script = os.path.join(_REPO, "tools", "bench_losscurve.py")
     corpus = os.path.join(_REPO, "data_cache", "real_corpus_ids.npy")
     if not (os.path.exists(script) and os.path.exists(corpus)):
-        # leave state unset so the capture retries once the corpus exists
-        log("losscurve prerequisites missing; will retry next window")
+        # retry while the corpus may still be building (make_corpus takes
+        # tens of minutes), but time-bounded: nothing here builds it, so
+        # without a bound the suite could never complete. The timer is
+        # in-process (not persisted) so a fresh watcher run always grants
+        # a fresh hour.
+        global _LOSSCURVE_FIRST_MISS
+        if _LOSSCURVE_FIRST_MISS is None:
+            _LOSSCURVE_FIRST_MISS = time.monotonic()
+        waited = time.monotonic() - _LOSSCURVE_FIRST_MISS
+        if waited > 3600.0:
+            state["losscurve"] = {"skipped": "corpus never built"}
+            log("losscurve prerequisites missing for >1h; marking skipped")
+        else:
+            log(f"losscurve prerequisites missing ({waited:.0f}s); will retry")
         return
     res, err = run_child("losscurve", [sys.executable, script], {},
                          timeout=1800.0)
@@ -259,7 +274,8 @@ def commit_artifacts(state: dict) -> None:
     payload = {
         "written_at": _now(),
         "device_kind": (state.get("gpt") or {}).get("device_kind"),
-        "results": state,
+        # "_"-prefixed keys are internal bookkeeping, not capture results
+        "results": {k: v for k, v in state.items() if not k.startswith("_")},
         "raw_logs": sorted(p for p in os.listdir(ART) if p.endswith(".log")),
     }
     with open(bench_self, "w") as f:
@@ -270,7 +286,8 @@ def commit_artifacts(state: dict) -> None:
         _git(["add", "-A", "--", "bench_artifacts", "BENCH_SELF.json"])
         # never commit the raw (untarred) trace directory
         _git(["reset", "-q", "--", "bench_artifacts/trace_gpt"])
-        done = [k for k, v in state.items() if v and "skipped" not in v]
+        done = [k for k, v in state.items()
+                if isinstance(v, dict) and v and "skipped" not in v]
         r = _git(["commit",
                   "-m", f"Capture on-chip benchmark artifacts ({', '.join(done)})",
                   "--", "bench_artifacts", "BENCH_SELF.json"])
