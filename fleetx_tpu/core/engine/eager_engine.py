@@ -94,6 +94,10 @@ class EagerEngine(BasicEngine):
         self.eval_freq = _int(eng, "eval_freq", 0)
         self.eval_iters = _int(eng, "eval_iters", 10)
         self.accumulate_steps = max(_int(eng, "accumulate_steps", 1), 1)
+        # "step" (GPT pretrain): loop the loader until max_steps; "epoch"
+        # (ViT-style): stop after epoch_num passes (reference run_mode,
+        # eager_engine.py:250-330)
+        self.run_mode = str(eng.get("run_mode") or "step")
         save_load = dict(eng.get("save_load") or {})
         self.save_steps = _int(save_load, "save_steps", 0)
         self.output_dir = save_load.get("output_dir", "./output")
@@ -362,12 +366,25 @@ class EagerEngine(BasicEngine):
         if start_step >= self.max_steps:
             logger.info("checkpoint already at step %d >= max_steps", start_step)
             return
+        if self.run_mode == "epoch" and self._start_epoch >= epoch_num:
+            logger.info("checkpoint already at epoch %d >= epoch_num %d",
+                        self._start_epoch, epoch_num)
+            return
+
+        # epoch accounting: the first pass over the loader is the epoch the
+        # checkpoint resumed at (meta "epoch"); each loader re-iteration
+        # advances it. In "epoch" run_mode, epoch_num bounds the run; in
+        # "step" mode (GPT pretrain) the loader loops until max_steps.
+        self._epoch = self._start_epoch
 
         def batches():
             yield first
             for b in it:
                 yield self.module.pretreating_batch(b)
             while True:  # re-iterate epochs over the same loader
+                self._epoch += 1
+                if self.run_mode == "epoch" and self._epoch >= epoch_num:
+                    return
                 got = False
                 for b in train_data_loader:
                     got = True
@@ -405,7 +422,8 @@ class EagerEngine(BasicEngine):
                     loss = float(metrics["loss"])
                     losses.append(loss)
                     self.module.training_step_end({
-                        "global_step": step, "epoch": 0, "batch": window,
+                        "global_step": step, "epoch": self._epoch,
+                        "batch": window,
                         "loss": loss, "train_cost": cost,
                         "global_batch_size": global_batch,
                         "lr": float(metrics.get("lr", 0.0)),
@@ -504,7 +522,8 @@ class EagerEngine(BasicEngine):
         return ckpt_lib.save_checkpoint(
             self.output_dir, step, meta.unbox(self.state),
             meta={"consumed_samples": self._consumed_samples,
-                  "epoch": self._start_epoch, "seed": self.seed},
+                  "epoch": getattr(self, "_epoch", self._start_epoch),
+                  "seed": self.seed},
             async_save=self.async_save)
 
     def load(self, directory: Optional[str] = None):

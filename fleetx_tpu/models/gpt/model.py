@@ -561,18 +561,27 @@ def chunked_cross_entropy_per_token(x: jax.Array, wte: jax.Array,
     the same math as ``cross_entropy_per_token``).
     """
     V, _ = wte.shape
-    chunk = min(int(vocab_chunk), V)
+    # snap the chunk near-tight under the requested cap: the naive
+    # ceil-divide padded the head matmul (8192 padded 50304 -> 57344, 14%
+    # wasted FLOPs across all four fwd/bwd head passes). Shrink to the
+    # smallest chunk with the same count, then re-align up to 128 lanes for
+    # the MXU — never exceeding the requested chunk (it is a memory cap).
+    cap = min(int(vocab_chunk), V)
+    n_chunks = -(-V // cap)
+    base = -(-V // n_chunks)  # smallest chunk with that count
+    chunk = min(-(-base // 128) * 128, cap)
     n_chunks = -(-V // chunk)
     pad = n_chunks * chunk - V
-    wte_p = jnp.pad(wte, ((0, pad), (0, 0)))
+    wte_p = jnp.pad(wte, ((0, pad), (0, 0))) if pad else wte
     wte_ch = wte_p.reshape(n_chunks, chunk, wte.shape[1])
 
     def fold(acc, xs):
         m, l, lab = acc
         ci, w = xs
         logits = jnp.einsum("bsh,vh->bsv", x, w).astype(jnp.float32)
-        ids = ci * chunk + jnp.arange(chunk)
-        logits = jnp.where(ids < V, logits, _NEG_INF_F32)
+        if pad:
+            ids = ci * chunk + jnp.arange(chunk)
+            logits = jnp.where(ids < V, logits, _NEG_INF_F32)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         l = l * jnp.exp(m - m_new) + jnp.exp(
             logits - m_new[..., None]).sum(axis=-1)

@@ -77,8 +77,9 @@ class GeneralClsModule(BasicModule):
         speed = 1.0 / max(log_dict.get("train_cost", 1e-9), 1e-9)
         ips = log_dict.get("global_batch_size", 1) * speed
         logger.info(
-            "[train] global step %d, batch: %d, loss: %.9f, "
+            "[train] global step %d, epoch: %d, batch: %d, loss: %.9f, "
             "avg_batch_cost: %.5f sec, speed: %.2f step/s, ips: %.1f images/s, "
             "learning rate: %.5e",
-            log_dict["global_step"], log_dict["batch"], log_dict["loss"],
+            log_dict["global_step"], log_dict.get("epoch", 0),
+            log_dict["batch"], log_dict["loss"],
             log_dict.get("train_cost", 0.0), speed, ips, log_dict.get("lr", 0.0))

@@ -210,3 +210,59 @@ def test_prng_impl_rbg(devices8):
     eng.max_steps = 2
     losses = eng.fit(make_batches(2))
     assert len(losses) == 2 and all(np.isfinite(losses)), losses
+
+
+def test_epoch_mode_respects_epoch_num_and_logs_epochs(devices8):
+    """run_mode=epoch (ViT-style): stop after epoch_num passes over the
+    loader and report the real epoch index (VERDICT r4 #7 — `fit` used to
+    ignore epoch_num and log `epoch: 0` forever)."""
+    cfg = tiny_cfg()
+    cfg["Engine"].update(run_mode="epoch", max_steps=1000)
+    mesh = build_mesh({}, devices=devices8[:1])
+    eng = build_engine(cfg, mesh)
+    eng.max_steps = 1000
+    seen = []
+    orig = eng.module.training_step_end
+    eng.module.training_step_end = lambda log: (seen.append(log["epoch"]),
+                                                orig(log))[-1]
+    losses = eng.fit(make_batches(4, seed=5), epoch_num=3)
+    # 3 epochs x 4 batches, NOT 1000 steps
+    assert len(losses) == 12, len(losses)
+    assert seen == [0] * 4 + [1] * 4 + [2] * 4, seen
+    assert eng._epoch == 3
+
+
+def test_step_mode_loops_loader_past_epoch_num(devices8):
+    """run_mode=step (GPT pretrain, the default): epoch_num does NOT bound
+    the run — the loader re-iterates until max_steps."""
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = 6
+    mesh = build_mesh({}, devices=devices8[:1])
+    eng = build_engine(cfg, mesh)
+    losses = eng.fit(make_batches(2, seed=6), epoch_num=1)
+    assert len(losses) == 6  # 3 passes over the 2-batch loader
+
+
+def test_epoch_survives_checkpoint_roundtrip(devices8, tmp_path):
+    """The epoch reached is saved and restored (resume starts at the
+    checkpointed epoch, not 0)."""
+    cfg = tiny_cfg()
+    cfg["Engine"].update(run_mode="epoch", max_steps=1000,
+                         save_load={"save_steps": 1000,
+                                    "output_dir": str(tmp_path)})
+    mesh = build_mesh({}, devices=devices8[:1])
+    eng = build_engine(cfg, mesh)
+    eng.max_steps = 1000
+    eng.fit(make_batches(2, seed=7), epoch_num=2)
+    assert eng._epoch == 2
+    eng.save()
+
+    eng2 = build_engine(cfg, mesh)
+    eng2.prepare(make_batches(1, seed=7)[0])
+    assert eng2.load(str(tmp_path))
+    assert eng2._start_epoch == 2
+    # resuming a finished epoch-mode run must train ZERO further steps
+    # (the first loader pass is not exempt from the epoch_num bound)
+    eng2.max_steps = 1000
+    losses = eng2.fit(make_batches(2, seed=7), epoch_num=2)
+    assert not losses, losses

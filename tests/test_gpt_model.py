@@ -109,9 +109,13 @@ def test_param_count_345m():
     assert 340e6 < n < 420e6  # ~355M with 50304 vocab
 
 
-def test_chunked_lm_head_matches_full_logits_loss():
+@pytest.mark.parametrize("vc", [50, 33])
+def test_chunked_lm_head_matches_full_logits_loss(vc):
     """vocab_chunk computes the identical masked loss and parameter
-    gradients without materialising [b, s, V] logits."""
+    gradients without materialising [b, s, V] logits.
+
+    vc=50 tiles V=100 exactly (2 chunks, no padding); vc=33 keeps chunk 33
+    (4 x 33 = 132, exercises the padded tail)."""
     from flax.core import meta
 
     from fleetx_tpu.models.gpt.model import (GPTForPretraining,
@@ -137,8 +141,7 @@ def test_chunked_lm_head_matches_full_logits_loss():
         logits = full.apply({"params": p}, tokens, pos, deterministic=True)
         return cross_entropy_loss(logits, labels, mask)
 
-    # chunk 48 does not divide V=100 — the padded tail must be handled
-    chunked = GPTForPretraining(config_from_dict(dict(base, vocab_chunk=48)))
+    chunked = GPTForPretraining(config_from_dict(dict(base, vocab_chunk=vc)))
 
     def loss_chunked(p):
         return chunked.apply({"params": p}, tokens, pos, deterministic=True,
