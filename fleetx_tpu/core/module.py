@@ -205,6 +205,17 @@ class GPTModule(LanguageModule):
                                        batch["loss_mask"]))
             aux = sum(jnp.sum(l) for l in
                       jax.tree.leaves(aux_vars.get("losses", {})))
+            if self.model_cfg.pp_degree > 1:
+                # the pipeline sows one (bubble-gated) aux value per
+                # microbatch per layer; average back to one batch
+                # statistic, using the M pipeline_apply actually ran
+                from fleetx_tpu.parallel.pipeline import (
+                    effective_microbatches)
+
+                aux = aux / effective_microbatches(
+                    self.model_cfg.pp_microbatches
+                    or self.model_cfg.pp_degree,
+                    batch["tokens"].shape[0])
             return loss + aux, {"loss": loss, "moe_aux": aux}
         if self.model_cfg.vocab_chunk:
             # memory-efficient LM head: the model computes the masked loss

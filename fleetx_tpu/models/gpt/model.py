@@ -26,6 +26,7 @@ Key mappings (reference → here):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any, Optional
 
@@ -337,6 +338,7 @@ class TransformerDecoderLayer(nn.Module):
                  attention_mask: Optional[jax.Array] = None,
                  ) -> tuple[jax.Array, Optional[dict]]:
         cfg = self.cfg
+        layer_input = x
         residual = x
         y = LayerNorm(cfg, name="ln1")(x)
 
@@ -363,7 +365,17 @@ class TransformerDecoderLayer(nn.Module):
         if cfg.moe_num_experts > 0:
             from fleetx_tpu.models.gpt.moe import MoEMlp
 
-            y = MoEMlp(cfg, name="mlp")(y)
+            aux_gate = None
+            if cfg.pp_degree > 1 and layer_cache is None:
+                # Under the GPipe schedule, bubble blocks reach this layer
+                # as exact zeros (the pipeline wrapper re-zeroes bubble
+                # outputs, parallel/pipeline.py): gate their router
+                # statistics out of the load-balance loss. Tested on the
+                # LAYER input — the post-attention stream already carries
+                # nonzero bias terms even for a zero input.
+                aux_gate = (jnp.abs(layer_input).sum() > 0).astype(
+                    jnp.float32)
+            y = MoEMlp(cfg, name="mlp")(y, aux_gate=aux_gate)
         else:
             y = GPTMlp(cfg, name="mlp")(y)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
@@ -444,8 +456,6 @@ class GPTModel(nn.Module):
                 make_stage_stack, pipeline_apply)
 
             assert attention_mask is None, "pipeline mode is training-only"
-            assert cfg.moe_num_experts == 0, \
-                "MoE is not supported under pipeline parallelism yet"
             V = max(cfg.virtual_pp_degree, 1)
             chunks = cfg.pp_degree * V
             assert cfg.num_layers % chunks == 0
@@ -552,13 +562,23 @@ def chunked_cross_entropy_per_token(x: jax.Array, wte: jax.Array,
                                     vocab_chunk: int) -> jax.Array:
     """Token-level LM loss without materialising ``[b, s, V]`` logits.
 
-    Scans the tied-embedding head over vocab chunks, folding each chunk's
-    logits into a running online logsumexp and capturing the label logit
-    when the label falls in the chunk. Each fold is rematerialised, so peak
-    memory is one ``[b, s, vocab_chunk]`` f32 block in forward AND backward
-    — at GPT-345M bs8×seq1024 that replaces the ~1.65GB f32 logits (+ its
-    gradient) with ~33MB blocks at chunk 1024. Exact (online logsumexp is
-    the same math as ``cross_entropy_per_token``).
+    Splits the tied-embedding head over vocab chunks and computes each
+    chunk's statistics (row max, sum-exp at that max, label logit)
+    INDEPENDENTLY, then merges them — max of maxes, rescaled sum of
+    sum-exps — into the exact logsumexp. Because no chunk depends on
+    another, the static chunk loop is unrolled and XLA may overlap chunk
+    ``k+1``'s head matmul (MXU) with chunk ``k``'s reductions (VPU)
+    instead of serialising them the way a ``lax.scan`` accumulator chain
+    must (measured ~neutral on-chip at bs16 — the real chunking cost is
+    the remat'd 4th head matmul pass, see BENCHMARKS.md — but the unroll
+    removes the serialisation constraint for free). Each chunk is
+    rematerialised, so
+    peak memory stays one-ish ``[b, s, vocab_chunk]`` f32 block in
+    forward AND backward — at GPT-345M bs8×seq1024 that replaces the
+    ~1.65GB f32 logits (+ its gradient) with ~33MB blocks at chunk 1024.
+    Exact (merging per-chunk (m, l) pairs is the same math as the online
+    logsumexp). Falls back to the scan when the chunk count is large
+    enough that unrolling would bloat the program.
     """
     V, _ = wte.shape
     # snap the chunk near-tight under the requested cap: the naive
@@ -575,29 +595,41 @@ def chunked_cross_entropy_per_token(x: jax.Array, wte: jax.Array,
     wte_p = jnp.pad(wte, ((0, pad), (0, 0))) if pad else wte
     wte_ch = wte_p.reshape(n_chunks, chunk, wte.shape[1])
 
-    def fold(acc, xs):
-        m, l, lab = acc
-        ci, w = xs
+    @jax.checkpoint
+    def one_chunk(ci, w):
         logits = jnp.einsum("bsh,vh->bsv", x, w).astype(jnp.float32)
         if pad:
             ids = ci * chunk + jnp.arange(chunk)
             logits = jnp.where(ids < V, logits, _NEG_INF_F32)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        l = l * jnp.exp(m - m_new) + jnp.exp(
-            logits - m_new[..., None]).sum(axis=-1)
+        m = logits.max(axis=-1)
+        l = jnp.exp(logits - m[..., None]).sum(axis=-1)
         local = jnp.clip(labels - ci * chunk, 0, chunk - 1)
         ll = jnp.take_along_axis(logits, local[..., None], axis=-1)[..., 0]
         in_ch = (labels >= ci * chunk) & (labels < (ci + 1) * chunk)
-        lab = jnp.where(in_ch, ll, lab)
-        return (m_new, l, lab), None
+        return m, l, jnp.where(in_ch, ll, 0.0)
+
+    if n_chunks <= 32:
+        stats = [one_chunk(jnp.int32(ci), wte_ch[ci])
+                 for ci in range(n_chunks)]
+        m = functools.reduce(jnp.maximum, [s_[0] for s_ in stats])
+        l = sum(s_[1] * jnp.exp(s_[0] - m) for s_ in stats)
+        lab = sum(s_[2] for s_ in stats)  # label lands in exactly one chunk
+        return m + jnp.log(l) - lab
+
+    def fold(acc, xs):
+        m, l, lab = acc
+        ci, w = xs
+        cm, cl, clab = one_chunk(ci, w)
+        m_new = jnp.maximum(m, cm)
+        l = l * jnp.exp(m - m_new) + cl * jnp.exp(cm - m_new)
+        return (m_new, l, lab + clab), None
 
     b, s = labels.shape
     m0 = jnp.full((b, s), _NEG_INF_F32, jnp.float32)
     l0 = jnp.zeros((b, s), jnp.float32)
     lab0 = jnp.zeros((b, s), jnp.float32)
     (m, l, lab), _ = jax.lax.scan(
-        jax.checkpoint(fold), (m0, l0, lab0),
-        (jnp.arange(n_chunks), wte_ch))
+        fold, (m0, l0, lab0), (jnp.arange(n_chunks), wte_ch))
     return m + jnp.log(l) - lab
 
 

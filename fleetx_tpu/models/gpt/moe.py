@@ -33,7 +33,8 @@ class MoEMlp(nn.Module):
     cfg: "GPTConfig"  # noqa: F821 — GPTConfig (avoids a circular import)
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 aux_gate: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         E, k = cfg.moe_num_experts, cfg.moe_top_k
         b, s, h = x.shape
@@ -95,6 +96,16 @@ class MoEMlp(nn.Module):
         f_e = onehot[:, 0, :].mean(axis=0)
         p_e = probs.mean(axis=0)
         aux = (E * jnp.sum(f_e * p_e)).astype(jnp.float32)
+        if aux_gate is not None:
+            # Pipeline mode (aux gate from the caller, model.py): GPipe
+            # bubble iterations run this routing on zero blocks whose
+            # outputs are dropped — zero their aux contribution. The
+            # surviving per-microbatch values are averaged back to one
+            # batch statistic by GPTModule.training_loss (the standard
+            # GShard/Switch semantics under microbatching; it equals the
+            # full-batch statistic up to inter-microbatch covariance of
+            # f_e and P_e, which is zero at init and stays negligible).
+            aux = aux * aux_gate
         self.sow("losses", "moe_aux", cfg.moe_aux_weight * aux)
 
         return y.reshape(b, s, h)

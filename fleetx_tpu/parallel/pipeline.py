@@ -36,7 +36,20 @@ from typing import Any, Type
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["make_stage_stack", "pipeline_apply"]
+__all__ = ["make_stage_stack", "pipeline_apply", "effective_microbatches"]
+
+
+def effective_microbatches(num_microbatches: int, batch: int) -> int:
+    """The microbatch count ``pipeline_apply`` actually runs for ``batch``.
+
+    Param-init traces (single sample) and scaled-down proxy batches keep
+    the schedule shape with M capped at the batch size; everything that
+    normalises per-microbatch quantities (e.g. the MoE aux loss in
+    ``GPTModule.training_loss``) must use the same cap."""
+    if batch % num_microbatches and batch < num_microbatches and (
+            batch == 1 or num_microbatches % batch == 0):
+        return batch
+    return num_microbatches
 
 
 def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
@@ -78,6 +91,14 @@ def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
 
         def __call__(self, x):  # noqa: D102 — see class docstring
             out, _ = super().__call__(x, None, self.pipe_deterministic, None)
+            if self.cfg.moe_num_experts > 0:
+                # MoE layers gate their load-balance aux loss on
+                # "layer input is a zero bubble block" (model.py). Layer
+                # biases would turn a zero block nonzero after one layer,
+                # so re-zero bubble outputs to keep that test exact at
+                # every layer boundary (bubble outputs are dropped by the
+                # schedule anyway).
+                out = out * (jnp.abs(x).sum() > 0).astype(out.dtype)
             return out, None  # (carry, per-layer out) for the layer scan
 
     _PipeLayer.__name__ = getattr(layer_cls, "__name__", "PipeLayer")
@@ -85,9 +106,11 @@ def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
     if remat:
         target = nn.remat(_PipeLayer, prevent_cse=False, policy=remat_policy)
 
+    # "losses" rides along so MoE layers can sow their load-balance aux
+    # loss from inside the stage stack (bubble-masked in moe.py)
     stage = nn.scan(
         target,
-        variable_axes={"params": 0},
+        variable_axes={"params": 0, "losses": 0},
         split_rngs={"params": True, "dropout": True},
         out_axes=0,
         length=layers_per_stage,
@@ -95,7 +118,7 @@ def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
     )
     stages = nn.vmap(
         stage,
-        variable_axes={"params": 0},
+        variable_axes={"params": 0, "losses": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=0,
         out_axes=0,
@@ -106,7 +129,7 @@ def make_stage_stack(layer_cls: Type[nn.Module], num_stages: int,
         return _with_det(stages, deterministic)
     stages = nn.vmap(
         stages,
-        variable_axes={"params": 0},
+        variable_axes={"params": 0, "losses": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=0,
         out_axes=0,
@@ -147,17 +170,14 @@ def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
     """
     S, M, V = num_stages, num_microbatches, num_repeats
     batch = x.shape[0]
+    # proxy-batch capping (e.g. tracing the 175B recipe, accumulate_steps
+    # 1536, with a 16-sample batch) — shared with aux-loss normalisation
+    M = effective_microbatches(M, batch)
     if batch % M:
-        # Param-init traces (single sample) and scaled-down proxy batches
-        # (e.g. tracing the 175B recipe, accumulate_steps 1536, with a
-        # 16-sample batch) keep the schedule shape with M capped at the
-        # batch size. A real batch that neither divides into nor divides M
-        # is a config error, not something to silently degrade over.
-        if batch < M and (batch == 1 or M % batch == 0):
-            M = batch
-        else:
-            raise ValueError(
-                f"batch {batch} not divisible by pp_microbatches {M}")
+        # A real batch that neither divides into nor divides M is a config
+        # error, not something to silently degrade over.
+        raise ValueError(
+            f"batch {batch} not divisible by pp_microbatches {M}")
     mb = batch // M
     rest = x.shape[1:]
     act_axes = ("batch", "act_seq", "act_embed")
@@ -197,6 +217,7 @@ def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
     run = nn.scan(
         iteration,
         variable_broadcast="params",
+        variable_axes={"losses": 0},
         split_rngs={"params": False, "dropout": True},
         length=M + n_logical - 1,
         in_axes=0,

@@ -109,13 +109,15 @@ def test_param_count_345m():
     assert 340e6 < n < 420e6  # ~355M with 50304 vocab
 
 
-@pytest.mark.parametrize("vc", [50, 33])
+@pytest.mark.parametrize("vc", [50, 33, 2])
 def test_chunked_lm_head_matches_full_logits_loss(vc):
     """vocab_chunk computes the identical masked loss and parameter
     gradients without materialising [b, s, V] logits.
 
     vc=50 tiles V=100 exactly (2 chunks, no padding); vc=33 keeps chunk 33
-    (4 x 33 = 132, exercises the padded tail)."""
+    (4 x 33 = 132, exercises the padded tail); vc=2 gives 50 chunks and
+    exercises the lax.scan fallback the unrolled path (<= 32 chunks)
+    otherwise shadows."""
     from flax.core import meta
 
     from fleetx_tpu.models.gpt.model import (GPTForPretraining,
