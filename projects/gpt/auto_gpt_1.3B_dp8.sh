@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# GPT-1.3B auto-parallel pretraining over 8 chips (reference
+# projects/gpt/auto_gpt_1.3B_dp8.sh). The planner picks the mesh degrees;
+# the dp8 yaml seeds the device count.
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/auto.py \
+    -c fleetx_tpu/configs/nlp/gpt/auto/pretrain_gpt_1.3B_dp8.yaml "$@"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# GPT-1.3B auto-parallel pretraining, single chip (reference
+# projects/gpt/auto_gpt_1.3B_single_card.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/auto.py \
+    -c fleetx_tpu/configs/nlp/gpt/auto/pretrain_gpt_1.3B_single_card.yaml "$@"

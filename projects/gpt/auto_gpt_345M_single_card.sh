@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# GPT-345M auto-parallel pretraining, single chip (reference
+# projects/gpt/auto_gpt_345M_single_card.sh). tools/auto.py enables the
+# mesh-degree planner before training.
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/auto.py \
+    -c fleetx_tpu/configs/nlp/gpt/auto/pretrain_gpt_345M_single_card.yaml "$@"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# GPT-6.7B auto-parallel pretraining over 16 chips (reference
+# projects/gpt/auto_gpt_6.7B_sharding16.sh). Launch on every host of the
+# pod slice; the planner lands on a ZeRO-style fsdp/dp split.
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/auto.py \
+    -c fleetx_tpu/configs/nlp/gpt/auto/pretrain_gpt_6.7B_sharding16.yaml "$@"

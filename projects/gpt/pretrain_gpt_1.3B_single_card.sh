@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# GPT-1.3B single-chip pretraining (reference
+# projects/gpt/pretrain_gpt_1.3B_single_card.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/train.py \
+    -c fleetx_tpu/configs/nlp/gpt/pretrain_gpt_1.3B_single_card.yaml "$@"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Imagen 397M base stage at global batch 2048 over 64 chips (reference
+# projects/imagen/run_text2im_397M_64x64_bs2048.sh — 8 nodes × 8 GPUs via
+# paddle.distributed.launch; on TPU, launch this same command on every
+# host of the pod slice and jax.distributed wires the mesh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/train.py \
+    -c fleetx_tpu/configs/multimodal/imagen/imagen_397M_text2im_64x64_bs2048_dp64.yaml "$@"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Imagen super-resolution 64² → 512² stage (reference
+# projects/imagen/run_super_resolusion_512_single.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/train.py \
+    -c fleetx_tpu/configs/multimodal/imagen/imagen_super_resolution_512.yaml "$@"
