@@ -86,6 +86,12 @@ def _classify(err: str | None) -> str:
         return "unknown"
     if err == "timeout":
         return "timeout"
+    # the axon remote-compile helper wraps a compile-time HBM OOM in an
+    # INTERNAL (HTTP 500) — surface it as the OOM it is, so callers'
+    # dont-retry-what-cannot-fit logic (e.g. the watcher's bs32 skip)
+    # sees the real class
+    if "Ran out of memory" in err or "Exceeded hbm capacity" in err:
+        return "RESOURCE_EXHAUSTED"
     for cls in _ERROR_CLASSES:
         if cls in err:
             return cls
@@ -146,6 +152,8 @@ def _bench_impl() -> dict:
     model_kwargs = {}
     if VOCAB_CHUNK:
         model_kwargs["vocab_chunk"] = VOCAB_CHUNK
+    if os.environ.get("FLEETX_BENCH_SCAN_UNROLL"):
+        model_kwargs["scan_unroll"] = int(os.environ["FLEETX_BENCH_SCAN_UNROLL"])
     cfg = {
         "Model": dict(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=layers,
                       num_attention_heads=16, ffn_hidden_size=4096,

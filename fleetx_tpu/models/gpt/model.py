@@ -61,6 +61,7 @@ class GPTConfig:
     # TPU-native middle ground between memory and recompute FLOPs)
     recompute_granularity: str = "full"
     scan_layers: bool = True
+    scan_unroll: int = 1  # layers per scan-body unroll (perf lever)
     use_flash_attention: bool = True
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
     sequence_parallel: bool = False
@@ -90,6 +91,31 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+
+def _flash_residuals_saveable(prim, *_, **__) -> bool:
+    """Remat-policy predicate: save Pallas kernel outputs. The flash
+    kernel is a ``custom_vjp`` whose primal outputs (attention out + the
+    per-row logsumexp) ARE its backward residuals; remat inlines the vjp
+    fwd rule, so the policy sees them as outputs of the ``pallas_call``
+    primitive (verified — custom_vjp_call never reaches the policy, and
+    the ``shard_map`` of the sharded path is transparent too). The stock
+    dots policy rejects them (a Mosaic custom call is not a dot), which
+    made the "dots" granularity rerun the whole forward flash kernel
+    inside the backward — a 4th kernel pass worth ~21 ms/step at
+    GPT-345M bs8 (trace decomposition, BENCHMARKS.md round 5). Saving
+    them costs ~17 MB/layer at that shape. Count asserted by
+    ``tests/test_flash_attention.py::test_dots_policy_saves_flash_residuals``."""
+    return getattr(prim, "name", "") == "pallas_call"
+
+
+def _dots_policy(cfg: GPTConfig):
+    """The "dots" remat policy: matmul outputs + flash residuals."""
+    dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if not cfg.use_flash_attention:
+        return dots
+    return jax.checkpoint_policies.save_from_both_policies(
+        dots, _flash_residuals_saveable)
 
 
 def _dense_init(cfg: GPTConfig):
@@ -438,7 +464,7 @@ class GPTModel(nn.Module):
         if use_remat:
             policy = (jax.checkpoint_policies.nothing_saveable
                       if cfg.recompute_granularity == "full" else
-                      jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+                      _dots_policy(cfg))
             # deterministic/attention_mask are control flags, not data — keep
             # them static under remat (with dropout>0 they'd otherwise be
             # traced and break `not deterministic`)
@@ -488,6 +514,11 @@ class GPTModel(nn.Module):
                 out_axes=0,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
+                # >1 lets XLA overlap the scan's stacked-residual
+                # dynamic-update-slice traffic across adjacent layers (the
+                # ~1.8 ms/layer backward DUS cost in the trace
+                # decomposition, BENCHMARKS.md) at compile-time cost
+                unroll=max(int(cfg.scan_unroll), 1),
             )(cfg, name="layers")
             x, new_caches = stack(x, layer_caches, deterministic, attention_mask)
             new_cache = None

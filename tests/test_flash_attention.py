@@ -71,3 +71,34 @@ def test_default_blocks_match_supported_contract():
     k = jnp.zeros((1, 1536, 4, 64), jnp.bfloat16)
     assert FA.supported(q, q)                 # self-attention, non-512 seq
     assert FA.supported(q, k, causal=False)   # cross-attention defaults
+
+
+def test_dots_policy_saves_flash_residuals():
+    """Under "dots" remat the stock policy reruns the forward flash kernel
+    in the backward (its out/lse residuals are pallas_call outputs, not
+    dots). `_dots_policy` extends the policy to save them: the grad
+    program must contain exactly 3 flash kernels (fwd, dq, dkv) instead
+    of 4 (VERDICT r4 #6; ~21 ms/step at GPT-345M bs8 on-chip)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from fleetx_tpu.models.gpt.model import GPTConfig, _dots_policy
+    from fleetx_tpu.ops import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    shape = (2, 256, 4, 64)
+    q, k, v = (jnp.asarray(rng.randn(*shape), jnp.float32) for _ in range(3))
+    if not fa.supported(q, k):
+        import pytest
+        pytest.skip("flash unsupported on this backend")
+
+    def count_kernels(policy):
+        f = jax.checkpoint(lambda q: fa.flash_attention(q, k, v, causal=True),
+                           policy=policy)
+        jaxpr = jax.make_jaxpr(jax.grad(lambda q: f(q).sum()))(q)
+        return str(jaxpr).count("pallas_call")
+
+    stock = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    extended = _dots_policy(GPTConfig(use_flash_attention=True))
+    assert count_kernels(stock) == 4, count_kernels(stock)
+    assert count_kernels(extended) == 3, count_kernels(extended)
