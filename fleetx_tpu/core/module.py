@@ -331,10 +331,12 @@ class GPTGenerationModule(GPTModule):
         # use_topp_sampling flag is honoured when no strategy is given
         strategy = gen.get("decode_strategy")
         if strategy is not None:
-            assert strategy in ("sampling", "greedy_search"), strategy
+            assert strategy in ("sampling", "greedy_search", "beam_search"), \
+                strategy
             do_sample = strategy == "sampling"
         else:
             do_sample = bool(gen.get("use_topp_sampling", True))
+        self.use_beam_search = strategy == "beam_search"
         self.gen_cfg = GenerationConfig(
             max_new_tokens=int(gen.get("max_dec_len", 64)),
             min_new_tokens=int(gen.get("min_dec_len", 0)),
@@ -346,7 +348,14 @@ class GPTGenerationModule(GPTModule):
             num_return_sequences=int(gen.get("num_return_sequences", 1)),
             eos_token_id=int(gen.get("eos_token_id", 50256)),
             pad_token_id=int(gen.get("pad_token_id", 50256)),
+            # diverse beam knobs (reference hybrid_model.py:990-1004)
+            num_beams=int(gen.get("num_beams", 1)),
+            num_beam_groups=int(gen.get("num_beam_groups", 1)),
+            diversity_rate=float(gen.get("diversity_rate", 0.0)),
+            length_penalty=float(gen.get("length_penalty", 0.0)),
         )
+        if self.use_beam_search:
+            assert self.gen_cfg.num_return_sequences <= self.gen_cfg.num_beams
         self.tokenizer = None
         super().__init__(cfg)
 
@@ -358,6 +367,15 @@ class GPTGenerationModule(GPTModule):
         from fleetx_tpu.models.gpt import generation as G
 
         tokens, mask = G.left_pad(prompts, self.gen_cfg.pad_token_id)
+        if getattr(self, "use_beam_search", False):
+            seqs, _ = G.beam_search(self.model, meta.unbox(params),
+                                    self.gen_cfg, jnp.asarray(tokens),
+                                    jnp.asarray(mask))
+            # beams come back best-first per prompt: keep the top
+            # num_return_sequences rows of each prompt's num_beams block
+            nb, nr = self.gen_cfg.num_beams, self.gen_cfg.num_return_sequences
+            seqs = seqs.reshape(len(prompts), nb, -1)[:, :nr]
+            return jax.device_get(seqs.reshape(len(prompts) * nr, -1))
         out = G.generate(self.model, meta.unbox(params), self.gen_cfg,
                          jnp.asarray(tokens), jnp.asarray(mask), rng)
         return jax.device_get(out)

@@ -187,6 +187,14 @@ class GenerationConfig:
     pad_token_id: int = 50256
     forced_bos_token_id: Optional[int] = None
     forced_eos_token_id: Optional[int] = None
+    # diverse group beam search (reference hybrid_model.py:990-1004,
+    # HammingDiversityLogitsProcessor): used when decode_strategy is
+    # "beam_search"; groups decode sequentially per step and later groups
+    # are penalised for reusing earlier groups' current tokens
+    num_beams: int = 1
+    num_beam_groups: int = 1
+    diversity_rate: float = 0.0
+    length_penalty: float = 0.0
 
 
 def left_pad(prompts: Sequence[Sequence[int]], pad_id: int,
@@ -203,6 +211,25 @@ def left_pad(prompts: Sequence[Sequence[int]], pad_id: int,
         tokens[i, width - len(p):] = p
         mask[i, width - len(p):] = 1
     return tokens, mask
+
+
+def build_processors(gen_cfg: GenerationConfig) -> list:
+    """The processor chain both decoders share (reference
+    ``get_logits_processor``): every knob behaves the same under sampling,
+    greedy and beam decoding."""
+    processors = []
+    if gen_cfg.forced_bos_token_id is not None:
+        processors.append(forced_bos_processor(gen_cfg.forced_bos_token_id))
+    if gen_cfg.min_new_tokens:
+        processors.append(min_length_processor(gen_cfg.min_new_tokens,
+                                               gen_cfg.eos_token_id))
+    if gen_cfg.repetition_penalty != 1.0:
+        processors.append(
+            repetition_penalty_processor(gen_cfg.repetition_penalty))
+    if gen_cfg.forced_eos_token_id is not None:
+        processors.append(forced_eos_processor(gen_cfg.max_new_tokens,
+                                               gen_cfg.forced_eos_token_id))
+    return processors
 
 
 def generate(model, params: Any, gen_cfg: GenerationConfig,
@@ -243,17 +270,7 @@ def generate(model, params: Any, gen_cfg: GenerationConfig,
                             mask=jnp.repeat(cache.mask, n_ret, axis=0))
     b = b0 * n_ret
 
-    processors = []
-    if gen_cfg.forced_bos_token_id is not None:
-        processors.append(forced_bos_processor(gen_cfg.forced_bos_token_id))
-    if gen_cfg.min_new_tokens:
-        processors.append(min_length_processor(gen_cfg.min_new_tokens,
-                                               gen_cfg.eos_token_id))
-    if gen_cfg.repetition_penalty != 1.0:
-        processors.append(repetition_penalty_processor(gen_cfg.repetition_penalty))
-    if gen_cfg.forced_eos_token_id is not None:
-        processors.append(forced_eos_processor(gen_cfg.max_new_tokens,
-                                               gen_cfg.forced_eos_token_id))
+    processors = build_processors(gen_cfg)
 
     def sample_token(logits, step, ctx, rng):
         # processors see the FULL context (prompt + generated so far) with a
@@ -306,3 +323,143 @@ def generate(model, params: Any, gen_cfg: GenerationConfig,
     state = (jnp.int32(1), cache, ctx0, done0, first, rng)
     _, _, ctx, _, _, _ = jax.lax.while_loop(cond, body, state)
     return ctx[:, prompt_len:]
+
+
+def beam_search(model, params: Any, gen_cfg: GenerationConfig,
+                tokens: jax.Array, attention_mask: jax.Array):
+    """Diverse group beam search — the decoder that drives
+    ``hamming_diversity_processor`` (the reference wires the processor via
+    ``get_logits_processor`` but raises on any non-sampling strategy,
+    ``hybrid_model.py:1421-1431``; this is the working superset).
+
+    ``num_beams`` beams split into ``num_beam_groups`` groups. Each step one
+    batched forward scores ALL beams (one MXU pass), then groups select
+    sequentially: group g's log-probs are penalised by ``diversity_rate`` ×
+    (frequency of each token among groups < g's picks this step) before its
+    per-group ``top_k`` over ``group_size·vocab`` candidates. The KV cache
+    is gathered along the batch axis to follow the chosen parents — the
+    beam-reorder that reference-style decoders do with ``gather_tree``.
+
+    Finished beams (emitted eos) propose only ``pad`` at zero incremental
+    score, freezing their total. Returns ``(sequences, scores)``:
+    ``[b·num_beams, max_new_tokens]`` (prompt-major, best-first per prompt)
+    and ``[b, num_beams]`` length-penalised scores, sorted to match.
+    """
+    cfg: GPTConfig = model.cfg
+    nb, ng = int(gen_cfg.num_beams), max(int(gen_cfg.num_beam_groups), 1)
+    assert nb >= 1 and nb % ng == 0, (nb, ng)
+    gs = nb // ng
+    pad, eos = gen_cfg.pad_token_id, gen_cfg.eos_token_id
+    b0, prompt_len = tokens.shape
+    B = b0 * nb
+    max_new = int(gen_cfg.max_new_tokens)
+    div = hamming_diversity_processor(gen_cfg.diversity_rate, nb, ng)
+
+    cache = init_cache(cfg, b0, prompt_len + max_new)
+    logits, cache = model.apply(
+        {"params": params}, tokens, None, cache=cache, deterministic=True,
+        attention_mask=attention_mask)
+    V = logits.shape[-1]
+    cache = DecodeCache(key=jnp.repeat(cache.key, nb, axis=1),
+                        value=jnp.repeat(cache.value, nb, axis=1),
+                        index=cache.index,
+                        mask=jnp.repeat(cache.mask, nb, axis=0))
+    beam_tokens = jnp.repeat(tokens, nb, axis=0)
+    beam_mask = jnp.repeat(attention_mask, nb, axis=0)
+    base_pos = beam_mask.astype(jnp.int32).sum(axis=1)
+    pad_only = jnp.full((V,), NEG_INF, jnp.float32).at[pad].set(0.0)
+    processors = build_processors(gen_cfg)
+
+    def process_logits(logits, seqs, step):
+        """Run the shared processor chain (min-length, repetition penalty,
+        forced bos/eos) on per-beam logits before normalisation — every
+        Generation knob behaves identically under beam decoding."""
+        if not processors:
+            return logits
+        gen_valid = jnp.broadcast_to(
+            jnp.arange(max_new)[None, :] < step, (B, max_new))
+        ctx = jnp.concatenate([beam_tokens, seqs], axis=1)
+        ctx_mask = jnp.concatenate([beam_mask.astype(bool), gen_valid], axis=1)
+        for proc in processors:
+            logits = proc(logits, step, ctx, ctx_mask)
+        return logits
+
+    def select(lp_flat, scores, done):
+        """One step's group-sequential beam update. ``lp_flat`` [B, V]
+        log-probs, ``scores``/``done`` [b0, ng, gs]. Returns within-prompt
+        parent indices [b0, nb], chosen tokens [b0, nb], new scores."""
+        lp = lp_flat.reshape(b0, ng, gs, V)
+        current = jnp.full((b0, nb), pad, jnp.int32)
+        parents, toks, new_scores = [], [], []
+        for g in range(ng):
+            lp_g = lp[:, g].reshape(b0 * gs, V)
+            if gen_cfg.diversity_rate:
+                lp_g = div(lp_g, current.reshape(-1), g)
+            lp_g = lp_g.reshape(b0, gs, V)
+            lp_g = jnp.where(done[:, g, :, None], pad_only[None, None, :], lp_g)
+            cand = scores[:, g, :, None] + lp_g
+            top_s, top_i = jax.lax.top_k(cand.reshape(b0, gs * V), gs)
+            parents.append(g * gs + top_i // V)
+            toks.append((top_i % V).astype(jnp.int32))
+            new_scores.append(top_s)
+            current = current.at[:, g * gs:(g + 1) * gs].set(toks[-1])
+        return (jnp.concatenate(parents, axis=1),
+                jnp.concatenate(toks, axis=1),
+                jnp.stack(new_scores, axis=1))
+
+    def reorder(parent, tok, cache, seqs, done, lens, step):
+        """Gather beam state behind the chosen parents, append the tokens."""
+        flat = (jnp.arange(b0)[:, None] * nb + parent).reshape(-1)
+        cache = DecodeCache(key=cache.key[:, flat], value=cache.value[:, flat],
+                            index=cache.index, mask=cache.mask[flat])
+        seqs, done, lens = seqs[flat], done.reshape(-1)[flat], lens[flat]
+        tokf = jnp.where(done, pad, tok.reshape(-1))
+        seqs = jax.lax.dynamic_update_slice_in_dim(seqs, tokf[:, None], step,
+                                                   axis=1)
+        lens = lens + (~done).astype(jnp.int32)
+        done = done | (tokf == eos)
+        return cache, seqs, done.reshape(b0, ng, gs), lens, tokf
+
+    # within each group only beam 0 starts live — otherwise every beam of a
+    # group proposes identical candidates and top_k returns duplicates
+    scores0 = jnp.where(jnp.arange(gs)[None, None, :] == 0, 0.0, NEG_INF)
+    scores0 = jnp.broadcast_to(scores0, (b0, ng, gs)).astype(jnp.float32)
+    done0 = jnp.zeros((b0, ng, gs), bool)
+    seqs0 = jnp.full((B, max_new), pad, jnp.int32)
+    lens0 = jnp.zeros((B,), jnp.int32)
+
+    first_logits = process_logits(
+        jnp.repeat(logits[:, -1].astype(jnp.float32), nb, axis=0), seqs0,
+        jnp.int32(0))
+    parent, tok, scores = select(jax.nn.log_softmax(first_logits), scores0,
+                                 done0)
+    cache, seqs, done, lens, last = reorder(parent, tok, cache, seqs0, done0,
+                                            lens0, jnp.int32(0))
+
+    def cond(state):
+        step, _, _, _, done, _, _ = state
+        return (step < max_new) & ~jnp.all(done)
+
+    def body(state):
+        step, cache, seqs, scores, done, lens, last = state
+        tok_in = jnp.where(done.reshape(-1), pad, last)[:, None]
+        pos = (base_pos + step - 1)[:, None]
+        logits, cache = model.apply(
+            {"params": params}, tok_in, pos, cache=cache, deterministic=True)
+        lp = jax.nn.log_softmax(process_logits(
+            logits[:, -1].astype(jnp.float32), seqs, step))
+        parent, tok, scores = select(lp, scores, done)
+        cache, seqs, done, lens, last = reorder(parent, tok, cache, seqs,
+                                                done, lens, step)
+        return step + 1, cache, seqs, scores, done, lens, last
+
+    state = (jnp.int32(1), cache, seqs, scores, done, lens, last)
+    _, _, seqs, scores, _, lens, _ = jax.lax.while_loop(cond, body, state)
+
+    final = scores.reshape(b0, nb)
+    if gen_cfg.length_penalty:
+        final = final / jnp.maximum(lens.reshape(b0, nb), 1).astype(
+            jnp.float32) ** gen_cfg.length_penalty
+    order = jnp.argsort(-final, axis=1)
+    flat = (jnp.arange(b0)[:, None] * nb + order).reshape(-1)
+    return seqs[flat], jnp.take_along_axis(final, order, axis=1)
