@@ -66,6 +66,23 @@ def _named_shardings(abstract_tree: Any, mesh: Mesh, rules) -> Any:
         specs, is_leaf=lambda x: isinstance(x, P))
 
 
+def _device_hbm_gb(dist: dict) -> float:
+    """Per-device HBM for the offload advisory: the YAML's
+    ``auto_layout: {hbm_gb: N}`` wins, then the device's reported memory,
+    then the v5e default of 16 (axon does not report ``memory_stats``)."""
+    al = dist.get("auto_layout")
+    if isinstance(al, dict) and al.get("hbm_gb"):
+        return float(al["hbm_gb"])
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return float(limit) / (1 << 30)
+    except Exception:  # noqa: BLE001 — backends without memory_stats
+        pass
+    return 16.0
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Global batches are sharded over the combined data axes (reference
     ``env.get_data_world_size``: dp x sharding, ``utils/env.py:76-96``)."""
@@ -115,6 +132,22 @@ class EagerEngine(BasicEngine):
         self.sharding_stage = int((dist.get("sharding") or {}).get("sharding_stage") or 0)
         self.sharding_offload = bool(
             (dist.get("sharding") or {}).get("sharding_offload"))
+        if self.sharding_offload:
+            # offload is a fit-enabler that costs ~2.8x step time on-chip
+            # (BENCHMARKS.md); flag configs that would fit without it
+            from fleetx_tpu.parallel.auto_layout import (advice_inputs,
+                                                         offload_is_needed)
+
+            data_world = (int(dist.get("dp_degree") or 1)
+                          * int(dist.get("fsdp_degree") or 1))
+            mdl, mb, gran = advice_inputs(self.cfg, data_world=data_world)
+            hbm_gb = _device_hbm_gb(dist)
+            if not offload_is_needed(mdl, dist, micro_batch=mb,
+                                     recompute=gran, hbm_gb=hbm_gb):
+                logger.warning(
+                    "sharding_offload is on but the step estimate fits HBM "
+                    "without it — offload costs ~2.8x step time and should "
+                    "only be used when the model otherwise does not fit")
         if self.sharding_offload and jax.default_backend() != "tpu":
             # host memory-kind placement needs the TPU runtime; the virtual
             # CPU backend rejects replicated placement annotations

