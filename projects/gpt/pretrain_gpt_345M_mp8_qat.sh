@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# GPT-345M quantisation-aware pretraining over mp8 (reference
+# projects/gpt/pretrain_gpt_345M_mp8_qat.sh).
+set -eux
+cd "$(dirname "$0")/../.."
+
+python tools/supervise.py --max-restart 3 -- \
+    python tools/train.py \
+    -c fleetx_tpu/configs/nlp/gpt/pretrain_gpt_345M_mp8_qat.yaml "$@"
