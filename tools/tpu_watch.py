@@ -215,28 +215,52 @@ def _capture_gpt_seq2048(state: dict) -> None:
         log(f"gpt_seq2048 failed: {err or 'cpu fallback'}")
 
 
-def _capture_gpt_bs16_vc(state: dict) -> None:
-    # sweep chunk sizes: 16768 = V/3 exactly (fewest, biggest head matmuls);
-    # 8192 is the round-4 config. Keep the fastest healthy result.
+_TUNNEL_DEAD = ("timeout", "UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+
+def _bench_sweep(state: dict, key: str, variants) -> None:
+    """Run ``bench.py`` once per ``(suffix, env, annotate)`` variant and
+    keep the fastest healthy result in ``state[key]``.
+
+    A tunnel-dead error class aborts the sweep (the window is gone —
+    retry next window); a sweep where every attempt failed for any other
+    reason (OOM, compile crash — deterministic for a given config) marks
+    the key skipped after two such sweeps so it cannot pin the suite and
+    burn every future healthy window (the bs32 lesson)."""
     best = None
-    for vc in ("16768", "8192"):
-        res, err = run_child(f"gpt_bs16_vc{vc}", [sys.executable, "bench.py"],
-                             {"FLEETX_BENCH_RECOMPUTE": "dots",
-                              "FLEETX_BENCH_BS": "16",
-                              "FLEETX_BENCH_VOCAB_CHUNK": vc})
+    aborted = False
+    for suffix, env, annotate in variants:
+        res, err = run_child(f"{key}{suffix}", [sys.executable, "bench.py"],
+                             env)
         if res and res.get("device_kind") != "cpu":
-            res["vocab_chunk"] = int(vc)
+            res.update(annotate)
             if best is None or res["value"] > best["value"]:
                 best = res
         else:
-            log(f"gpt_bs16_vc[{vc}] failed: {err or 'cpu fallback'}")
-            # a dead tunnel dooms the rest of the sweep; any other failure
-            # (OOM, compile blowup) may be specific to THIS chunk size —
-            # keep going so the known-good config still gets captured
-            if err in ("timeout", "UNAVAILABLE", "DEADLINE_EXCEEDED"):
+            log(f"{key}[{suffix or 'base'}] failed: {err or 'cpu fallback'}")
+            if err in _TUNNEL_DEAD:
+                aborted = True
                 break
     if best:
-        state["gpt_bs16_vc"] = best
+        state[key] = best
+        state.pop(f"_{key}_fails", None)
+    elif not aborted:
+        fails = state.get(f"_{key}_fails", 0) + 1
+        state[f"_{key}_fails"] = fails
+        if fails >= 2:
+            state[key] = {"skipped": f"deterministic failures x{fails}"}
+            log(f"{key}: repeated deterministic failure; marking skipped")
+
+
+def _capture_gpt_bs16_vc(state: dict) -> None:
+    # sweep chunk sizes: 16768 = V/3 exactly (fewest, biggest head
+    # matmuls); 8192 is the round-4 config. Keep the fastest.
+    _bench_sweep(state, "gpt_bs16_vc",
+                 [(vc, {"FLEETX_BENCH_RECOMPUTE": "dots",
+                        "FLEETX_BENCH_BS": "16",
+                        "FLEETX_BENCH_VOCAB_CHUNK": vc},
+                   {"vocab_chunk": int(vc)})
+                  for vc in ("16768", "8192")])
 
 
 def _capture_gpt_bs32_vc(state: dict) -> None:
@@ -287,6 +311,29 @@ def _capture_losscurve(state: dict) -> None:
         log(f"losscurve failed: {err or 'cpu fallback'}")
 
 
+def _capture_gpt_policyfix(state: dict) -> None:
+    """Round-5 A/B: the dots remat policy now saves the flash (out, lse)
+    residuals (model.py:_dots_policy), removing the backward's 4th flash
+    kernel pass (~21 ms/step predicted from the trace decomposition,
+    BENCHMARKS.md). Same bench config as the canonical ``gpt`` capture,
+    which stays UNTOUCHED as the pre-fix baseline (its number matches the
+    committed trace tarball); the delta gpt_policyfix − gpt is the
+    measurement, and BENCHMARKS.md promotes the headline by hand."""
+    _bench_sweep(state, "gpt_policyfix",
+                 [("", {"FLEETX_BENCH_RECOMPUTE": "dots"}, {})])
+
+
+def _capture_gpt_unroll(state: dict) -> None:
+    """Scan-unroll sweep (the backward's stacked-residual DUS traffic,
+    ~1.8 ms/layer in the trace): keep the best of unroll 2/4. Read
+    against gpt_policyfix (same code, unroll 1)."""
+    _bench_sweep(state, "gpt_unroll",
+                 [(u, {"FLEETX_BENCH_RECOMPUTE": "dots",
+                       "FLEETX_BENCH_SCAN_UNROLL": u},
+                   {"scan_unroll": int(u)})
+                  for u in ("2", "4")])
+
+
 CAPTURES = [
     ("gpt", _capture_gpt),
     ("gpt_trace", _capture_gpt_trace),
@@ -295,6 +342,8 @@ CAPTURES = [
     ("gpt_bs16_vc", _capture_gpt_bs16_vc),
     ("gpt_bs32_vc", _capture_gpt_bs32_vc),
     ("losscurve", _capture_losscurve),
+    ("gpt_policyfix", _capture_gpt_policyfix),
+    ("gpt_unroll", _capture_gpt_unroll),
 ]
 
 
