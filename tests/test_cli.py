@@ -245,6 +245,36 @@ def test_train_eval_generate_cli_round_trip(tmp_path):
     assert "no checkpoint" not in (proc.stdout + proc.stderr), \
         (proc.stdout + proc.stderr)[-800:]
 
+    # diverse beam search through the same generation CLI + checkpoint
+    proc = _run(["tasks/gpt/generation.py", "-c",
+                 "fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_single_card.yaml",
+                 "-o", "Generation.decode_strategy=beam_search",
+                 "-o", "Generation.num_beams=4",
+                 "-o", "Generation.num_beam_groups=2",
+                 "-o", "Generation.diversity_rate=0.5",
+                 "-o", f"Generation.tokenizer_dir={tok_dir}",
+                 "-o", "Generation.input_text=the quick brown",
+                 "-o", "Generation.max_dec_len=8"] + TINY_RUN + GPT_SHAPES
+                + ["-o", f"Engine.save_load.ckpt_dir={out_dir}"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "no checkpoint" not in (proc.stdout + proc.stderr), \
+        (proc.stdout + proc.stderr)[-800:]
+
+
+def test_generation_cli_dp8_yaml():
+    """The dp8 generation recipe parses and decodes on the 8-device env
+    (tokens-in → ids-out path; random weights are fine for a smoke — the
+    checkpointed journey is covered by the round-trip test). The recipe's
+    OWN batch/degree settings stay in force — only the model is shrunk —
+    so a corrupted shipped recipe fails here."""
+    proc = _run(["tasks/gpt/generation.py", "-c",
+                 "fleetx_tpu/configs/nlp/gpt/generation_gpt_345M_dp8.yaml",
+                 "-o", "Generation.tokenizer_dir=",  # ids-in/ids-out smoke
+                 "-o", "Generation.input_text=5 9 23",
+                 "-o", "Generation.max_dec_len=4"] + GPT_SHAPES)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "[[" in proc.stdout, proc.stdout[-500:]  # printed id rows
+
 
 def test_supervisor_restarts_after_crash(tmp_path):
     """Restart wrapper e2e (VERDICT r3 #8; reference ``max_restart: 3``,
