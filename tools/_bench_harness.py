@@ -1,0 +1,33 @@
+"""Shared timing loop for the per-model bench children
+(``tools/bench_vit.py``, ``tools/bench_imagen.py``): one place for the
+warmup / block / timed-steps methodology so the scripts cannot diverge."""
+
+from __future__ import annotations
+
+import time
+
+
+def time_engine_steps(engine, batch: dict, warmup: int, n_steps: int):
+    """Init + shard, run ``warmup`` then ``n_steps`` timed train steps.
+
+    Returns ``(dt, loss, n_params)`` — mean step seconds (wall, after a
+    ``block_until_ready`` barrier), the final loss, and the model's
+    parameter count.
+    """
+    import jax
+
+    from fleetx_tpu.core.engine.eager_engine import _param_count
+
+    engine.prepare(batch)
+    n_params = _param_count(engine.state.params)
+    sharded = engine.shard_batch(batch)
+    with engine._ctx():
+        for _ in range(warmup):
+            engine.state, metrics = engine._train_step(engine.state, sharded)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            engine.state, metrics = engine._train_step(engine.state, sharded)
+        loss = float(jax.block_until_ready(metrics["loss"]))
+        dt = (time.perf_counter() - t0) / n_steps
+    return dt, loss, n_params

@@ -17,7 +17,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -37,8 +36,8 @@ def main() -> int:
         name, bsz = "ViT_tiny_patch16_224", 8
     warmup, n_steps = (1, 2) if scaled else (3, 10)
 
+    from _bench_harness import time_engine_steps
     from fleetx_tpu.core.engine import EagerEngine
-    from fleetx_tpu.core.engine.eager_engine import _param_count
     from fleetx_tpu.models.vision.module import GeneralClsModule
     from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
     from fleetx_tpu.optims.optimizer import build_optimizer
@@ -64,18 +63,7 @@ def main() -> int:
         "labels": rng.randint(0, 1000, size=(bsz,)).astype(np.int32),
     }
 
-    engine.prepare(batch)
-    n_params = _param_count(engine.state.params)
-    sharded = engine.shard_batch(batch)
-    with engine._ctx():
-        for _ in range(warmup):
-            engine.state, metrics = engine._train_step(engine.state, sharded)
-        jax.block_until_ready(metrics["loss"])
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            engine.state, metrics = engine._train_step(engine.state, sharded)
-        loss = float(jax.block_until_ready(metrics["loss"]))
-        dt = (time.perf_counter() - t0) / n_steps
+    dt, loss, n_params = time_engine_steps(engine, batch, warmup, n_steps)
 
     images_per_s = bsz / dt
     result = {

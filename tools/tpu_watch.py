@@ -188,20 +188,16 @@ def _capture_gpt_trace(state: dict) -> None:
 
 
 def _capture_vit(state: dict) -> None:
-    for name, bs in (("ViT_large_patch16_224", 128),
-                     ("ViT_large_patch16_224", 64),
-                     ("ViT_base_patch16_224", 256),
-                     ("ViT_base_patch16_224", 128)):
-        res, err = run_child(f"vit_{name}_bs{bs}",
-                             [sys.executable, "tools/bench_vit.py"],
-                             {"FLEETX_VIT_NAME": name,
-                              "FLEETX_VIT_BS": str(bs)})
-        if res and res.get("device_kind") != "cpu":
-            state["vit"] = res
-            return
-        log(f"vit[{name} bs{bs}] failed: {err or 'cpu fallback'}")
-        if not _is_oom(err):
-            return
+    """ViT-L/16 images/sec (north-star #2), falling down the size chain
+    until one fits."""
+    _bench_sweep(state, "vit",
+                 [(f"_{name}_bs{bs}", {"FLEETX_VIT_NAME": name,
+                                       "FLEETX_VIT_BS": str(bs)}, {})
+                  for name, bs in (("ViT_large_patch16_224", 128),
+                                   ("ViT_large_patch16_224", 64),
+                                   ("ViT_base_patch16_224", 256),
+                                   ("ViT_base_patch16_224", 128))],
+                 script="tools/bench_vit.py", first_success=True)
 
 
 def _capture_gpt_seq2048(state: dict) -> None:
@@ -218,9 +214,12 @@ def _capture_gpt_seq2048(state: dict) -> None:
 _TUNNEL_DEAD = ("timeout", "UNAVAILABLE", "DEADLINE_EXCEEDED")
 
 
-def _bench_sweep(state: dict, key: str, variants) -> None:
-    """Run ``bench.py`` once per ``(suffix, env, annotate)`` variant and
-    keep the fastest healthy result in ``state[key]``.
+def _bench_sweep(state: dict, key: str, variants, script: str = "bench.py",
+                 first_success: bool = False) -> None:
+    """Run ``script`` once per ``(suffix, env, annotate)`` variant and keep
+    the fastest healthy result in ``state[key]`` (or the first healthy one
+    with ``first_success`` — for fallback chains like bs16→bs8 where a
+    success ends the hunt).
 
     A tunnel-dead error class aborts the sweep (the window is gone —
     retry next window); a sweep where every attempt failed for any other
@@ -230,12 +229,13 @@ def _bench_sweep(state: dict, key: str, variants) -> None:
     best = None
     aborted = False
     for suffix, env, annotate in variants:
-        res, err = run_child(f"{key}{suffix}", [sys.executable, "bench.py"],
-                             env)
+        res, err = run_child(f"{key}{suffix}", [sys.executable, script], env)
         if res and res.get("device_kind") != "cpu":
             res.update(annotate)
             if best is None or res["value"] > best["value"]:
                 best = res
+            if first_success:
+                break
         else:
             log(f"{key}[{suffix or 'base'}] failed: {err or 'cpu fallback'}")
             if err in _TUNNEL_DEAD:
@@ -311,6 +311,15 @@ def _capture_losscurve(state: dict) -> None:
         log(f"losscurve failed: {err or 'cpu fallback'}")
 
 
+def _capture_imagen(state: dict) -> None:
+    """397M base64 stage images/sec — the one model family never timed
+    (tools/bench_imagen.py); bs16 per the reference recipe, bs8 fallback."""
+    _bench_sweep(state, "imagen",
+                 [(f"_bs{bs}", {"FLEETX_IMAGEN_BS": bs}, {})
+                  for bs in ("16", "8")],
+                 script="tools/bench_imagen.py", first_success=True)
+
+
 def _capture_gpt_policyfix(state: dict) -> None:
     """Round-5 A/B: the dots remat policy now saves the flash (out, lse)
     residuals (model.py:_dots_policy), removing the backward's 4th flash
@@ -344,6 +353,7 @@ CAPTURES = [
     ("losscurve", _capture_losscurve),
     ("gpt_policyfix", _capture_gpt_policyfix),
     ("gpt_unroll", _capture_gpt_unroll),
+    ("imagen", _capture_imagen),
 ]
 
 
