@@ -258,3 +258,55 @@ def test_auto_layout_accounts_for_activations():
     # the planner must grow tensor/pipeline degrees, not burn the device
     # budget on fsdp (review round-5 finding)
     assert d64["mp_degree"] * d64["pp_degree"] >= 4, d64
+
+
+def test_watcher_bench_sweep_semantics(monkeypatch):
+    """tools/tpu_watch._bench_sweep: keeps the best healthy variant,
+    aborts (for retry) on tunnel-dead classes, first_success stops the
+    fallback chain, and two all-deterministic-failure sweeps mark the key
+    skipped so a doomed config cannot pin the capture suite."""
+    import tools.tpu_watch as W
+
+    def run(results):
+        calls = []
+
+        def fake_run_child(name, argv, env, timeout=1200.0):
+            calls.append(name)
+            return results[len(calls) - 1]
+
+        monkeypatch.setattr(W, "run_child", fake_run_child)
+        return calls
+
+    ok = lambda v: ({"value": v, "device_kind": "TPU v5 lite"}, None)
+
+    # best-of sweep
+    state = {}
+    run([ok(10.0), ok(20.0)])
+    W._bench_sweep(state, "k", [("a", {}, {"tag": 1}), ("b", {}, {"tag": 2})])
+    assert state["k"]["value"] == 20.0 and state["k"]["tag"] == 2
+
+    # first_success stops the chain
+    state = {}
+    calls = run([ok(5.0), ok(50.0)])
+    W._bench_sweep(state, "k", [("a", {}, {}), ("b", {}, {})],
+                   first_success=True)
+    assert state["k"]["value"] == 5.0 and calls == ["ka"]
+
+    # tunnel death aborts WITHOUT counting toward the skip strikes
+    state = {}
+    run([(None, "timeout")])
+    W._bench_sweep(state, "k", [("a", {}, {}), ("b", {}, {})])
+    assert "k" not in state and "_k_fails" not in state
+
+    # two all-deterministic-failure sweeps mark skipped
+    state = {}
+    for _ in range(2):
+        run([(None, "RESOURCE_EXHAUSTED"), (None, "INTERNAL")])
+        W._bench_sweep(state, "k", [("a", {}, {}), ("b", {}, {})])
+    assert state["k"] == {"skipped": "deterministic failures x2"}
+
+    # a later success clears the strike counter
+    state = {"_k_fails": 1}
+    run([ok(7.0)])
+    W._bench_sweep(state, "k", [("a", {}, {})])
+    assert state["k"]["value"] == 7.0 and "_k_fails" not in state
