@@ -275,6 +275,8 @@ def test_watcher_bench_sweep_semantics(monkeypatch):
             return results[len(calls) - 1]
 
         monkeypatch.setattr(W, "run_child", fake_run_child)
+        # keep test chatter out of the real bench_artifacts audit log
+        monkeypatch.setattr(W, "log", lambda msg: None)
         return calls
 
     ok = lambda v: ({"value": v, "device_kind": "TPU v5 lite"}, None)
