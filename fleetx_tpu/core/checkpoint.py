@@ -19,8 +19,12 @@ import os
 import shutil
 from typing import Any, Optional
 
+import time
+
 import jax
 
+from fleetx_tpu.observability.metrics import get_registry
+from fleetx_tpu.observability.trace import span
 from fleetx_tpu.utils.log import logger
 
 try:
@@ -46,6 +50,19 @@ def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step}")
 
 
+def _tree_bytes(state: Any) -> int:
+    """Payload size of a pytree (telemetry: HBM/disk traffic per save)."""
+    total = 0
+    for leaf in jax.tree.leaves(state):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = getattr(leaf, "size", None)
+            dtype = getattr(leaf, "dtype", None)
+            nbytes = (size * dtype.itemsize) if size and dtype else 0
+        total += int(nbytes)
+    return total
+
+
 def save_checkpoint(directory: str, step: int, state: Any,
                     meta: Optional[dict] = None,
                     async_save: bool = False) -> str:
@@ -67,15 +84,25 @@ def save_checkpoint(directory: str, step: int, state: Any,
         logger.info("removing half-written checkpoint: %s", path)
         shutil.rmtree(path)
     ckptr = _get_checkpointer()
-    ckptr.save(os.path.join(path, "state"), state, force=True)
-    full_meta = dict(meta or {}, step=int(step))
-    if async_save:
-        _pending.append((path, full_meta))
-        logger.info("async checkpoint started: %s", path)
-        return path
-    ckptr.wait_until_finished()
-    _write_meta(path, full_meta)
-    logger.info("saved checkpoint: %s", path)
+    reg = get_registry()
+    t0 = time.perf_counter()
+    with span("checkpoint_write", step=int(step)):
+        ckptr.save(os.path.join(path, "state"), state, force=True)
+        full_meta = dict(meta or {}, step=int(step))
+        if async_save:
+            _pending.append((path, full_meta))
+            logger.info("async checkpoint started: %s", path)
+        else:
+            ckptr.wait_until_finished()
+            _write_meta(path, full_meta)
+            logger.info("saved checkpoint: %s", path)
+    # duration/bytes telemetry: async saves report the (short) snapshot
+    # window here; the drain shows up under ckpt_finalize
+    nbytes = _tree_bytes(state)
+    reg.histogram("ckpt_save").record(time.perf_counter() - t0)
+    reg.counter("ckpt_saves_total").inc()
+    reg.gauge("ckpt_bytes").set(nbytes)
+    reg.counter("ckpt_bytes_total").inc(nbytes)
     return path
 
 
@@ -89,11 +116,12 @@ def finalize_async_saves() -> None:
     """Block until outstanding async saves are durable and mark them complete."""
     if not _pending:
         return
-    _get_checkpointer().wait_until_finished()
-    while _pending:
-        path, meta = _pending.pop(0)
-        _write_meta(path, meta)
-        logger.info("async checkpoint finalized: %s", path)
+    with span("ckpt_finalize"), get_registry().timer("ckpt_finalize"):
+        _get_checkpointer().wait_until_finished()
+        while _pending:
+            path, meta = _pending.pop(0)
+            _write_meta(path, meta)
+            logger.info("async checkpoint finalized: %s", path)
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -207,7 +235,13 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
 
         request = jax.tree_util.tree_map_with_path(adapt, abstract_state)
 
-    state = ckptr.restore(os.path.join(path, "state"), request)
+    reg = get_registry()
+    t0 = time.perf_counter()
+    with span("checkpoint_restore", step=int(step)):
+        state = ckptr.restore(os.path.join(path, "state"), request)
+    reg.histogram("ckpt_restore").record(time.perf_counter() - t0)
+    reg.counter("ckpt_restores_total").inc()
+    reg.gauge("ckpt_bytes").set(_tree_bytes(state))
     if reshaped:
         logger.info("adapting pipeline layout of %d leaves on restore",
                     len(reshaped))

@@ -17,4 +17,10 @@ from fleetx_tpu.core.engine.eager_engine import EagerEngine
 
 
 class AutoEngine(EagerEngine):
-    """GSPMD-compiled engine (the reference auto stack, subsumed)."""
+    """GSPMD-compiled engine (the reference auto stack, subsumed).
+
+    Telemetry (docs/observability.md) is inherited wholesale: the same
+    ``Observability:`` YAML block, spans and sinks apply, and every emitted
+    record carries ``engine: "AutoEngine"`` so mixed eager/auto runs stay
+    distinguishable in one metrics stream.
+    """

@@ -37,6 +37,8 @@ from flax.core import meta
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fleetx_tpu.core import checkpoint as ckpt_lib
+from fleetx_tpu.observability import Observability
+from fleetx_tpu.observability.trace import ProfilerWindow
 from fleetx_tpu.parallel.mesh import build_mesh
 from fleetx_tpu.parallel.sharding import make_axis_rules, zero_sharding
 from fleetx_tpu.utils.log import logger
@@ -178,18 +180,16 @@ class EagerEngine(BasicEngine):
                           if prng_impl else jax.random.PRNGKey(self.seed))
 
         # profiler window (reference Profiler: config block + paddle.profiler
-        # integration, eager_engine.py:197-219,329-330,679-738)
-        prof = dict(self.cfg.get("Profiler") or {})
-        self.profiler_enabled = bool(prof.get("enable"))
-        sched = list(prof.get("scheduler") or [])
-        self.profiler_start = _int(prof, "start_step",
-                                   int(sched[0]) if sched else 3)
-        self.profiler_stop = _int(prof, "stop_step",
-                                  int(sched[1]) if len(sched) > 1
-                                  else self.profiler_start + 5)
-        self.profiler_dir = (prof.get("output_dir")
-                             or prof.get("profiler_log") or "./profiler_log")
-        self._profiling = False
+        # integration, eager_engine.py:197-219,329-330,679-738) — state
+        # machine owned by observability.trace.ProfilerWindow: re-armed per
+        # fit, and stop_trace drains device work first
+        self.profiler = ProfilerWindow(self.cfg.get("Profiler"))
+
+        # unified telemetry (docs/observability.md): metrics registry +
+        # span tracer + sinks, no-op unless Observability.enable is set
+        self.obs = Observability(self.cfg.get("Observability"),
+                                 default_output_dir=self.output_dir)
+        self._engine_kind = type(self).__name__
 
         self.optimizer = optimizer
         self.lr_schedule = lr_schedule
@@ -263,6 +263,13 @@ class EagerEngine(BasicEngine):
                         time.time() - t0,
                         _fmt_count(_param_count(self.state.params)))
         self._build_step_fns()
+        if self.obs.enabled and self.obs.derived is None:
+            fpt = None
+            if hasattr(self.module, "flops_per_token"):
+                fpt = self.module.flops_per_token()
+            # mesh.size, not device_count(): the run only uses (and its
+            # throughput only reflects) the mesh's devices
+            self.obs.init_derived(fpt, self.mesh.size)
         if self.ckpt_dir:
             self.load(self.ckpt_dir)
         return self.state
@@ -431,16 +438,22 @@ class EagerEngine(BasicEngine):
             losses = []
             step = start_step  # host-side mirror of state.step (no per-step sync)
             last_eval = last_save = -1  # fp16 resync can re-visit a step
-            for batch in batches():
-                if step >= self.max_steps:
+            self.profiler.arm()  # each fit gets its own trace window
+            batch_iter = iter(batches())
+            metrics: dict = {}
+            while step < self.max_steps:
+                with self.obs.timed_span("data_fetch"):
+                    batch = next(batch_iter, None)
+                if batch is None:
                     break
-                if self.profiler_enabled and not self._profiling and \
-                        step >= self.profiler_start:
-                    jax.profiler.start_trace(self.profiler_dir)
-                    self._profiling = True
-                    logger.info("profiler trace started → %s", self.profiler_dir)
-                sharded = self.shard_batch(batch)
-                self.state, metrics = self._train_step(self.state, sharded)
+                self.profiler.maybe_start(step)
+                with self.obs.timed_span("shard_batch"):
+                    sharded = self.shard_batch(batch)
+                # the span covers dispatch, not device runtime (the step is
+                # async); device time shows up in the XLA trace the
+                # TraceAnnotation nests under
+                with self.obs.span("train_step", step=step):
+                    self.state, metrics = self._train_step(self.state, sharded)
                 window += 1
                 self._consumed_samples += global_batch
                 step += 1
@@ -454,19 +467,18 @@ class EagerEngine(BasicEngine):
                     t_last = now
                     loss = float(metrics["loss"])
                     losses.append(loss)
-                    self.module.training_step_end({
+                    log_dict = {
                         "global_step": step, "epoch": self._epoch,
                         "batch": window,
                         "loss": loss, "train_cost": cost,
                         "global_batch_size": global_batch,
                         "lr": float(metrics.get("lr", 0.0)),
-                    })
-                if self._profiling and step >= self.profiler_stop:
-                    jax.block_until_ready(metrics.get("loss"))
-                    jax.profiler.stop_trace()
-                    self._profiling = False
-                    self.profiler_enabled = False  # one window per fit
-                    logger.info("profiler trace written to %s", self.profiler_dir)
+                    }
+                    self.module.training_step_end(log_dict)
+                    self._emit_train_record(log_dict, metrics)
+                # profiler stop drains in-flight device work via the step's
+                # loss value so the trace tail isn't truncated
+                self.profiler.maybe_stop(step, sync=metrics.get("loss"))
                 if self.eval_freq and valid_data_loader is not None and \
                         step % self.eval_freq == 0 and step != last_eval:
                     last_eval = step
@@ -482,11 +494,51 @@ class EagerEngine(BasicEngine):
                     # is exactly the restart-with-resume behaviour under test
                     logger.error("fault injection: dying at step %d", step)
                     os._exit(17)
-            if self._profiling:
-                jax.profiler.stop_trace()
-                self._profiling = False
+            self.profiler.stop(sync=metrics.get("loss")
+                               if isinstance(metrics, dict) else None)
             ckpt_lib.finalize_async_saves()
+            self.obs.flush()
             return losses
+
+    # ------------------------------------------------------------ telemetry
+    def _emit_train_record(self, log_dict: dict, metrics: dict) -> None:
+        """One machine-readable record per logging window → the sinks.
+
+        The record always carries the schema's required keys
+        (``observability/schema.py``): ``tokens_per_sec``/``mfu`` are null
+        rather than absent when underivable (non-LM module, unknown chip).
+        """
+        obs = self.obs
+        if not obs.enabled:
+            return
+        derived = {}
+        if obs.derived is not None:
+            derived = obs.derived.update(
+                log_dict["train_cost"], log_dict["global_batch_size"],
+                tokens_per_sample=getattr(self.module, "tokens_per_sample",
+                                          None),
+                steps_in_window=self.logging_freq,
+                stall_seconds_total=obs.stall_seconds_total())
+        record = {
+            "ts": time.time(),
+            "step": int(log_dict["global_step"]),
+            "epoch": int(log_dict.get("epoch", 0)),
+            "loss": float(log_dict["loss"]),
+            "step_time": float(log_dict["train_cost"]),
+            "tokens_per_sec": None,
+            "mfu": None,
+            "lr": float(log_dict.get("lr", 0.0)),
+            "global_batch_size": int(log_dict["global_batch_size"]),
+            "engine": self._engine_kind,
+        }
+        record.update(derived)
+        if "grad_norm" in metrics:
+            record["grad_norm"] = float(metrics["grad_norm"])
+        if "loss_scale" in metrics:
+            record["loss_scale"] = float(metrics["loss_scale"])
+        obs.registry.gauge("loss").set(record["loss"])
+        obs.registry.histogram("step_time").record(record["step_time"])
+        obs.emit(record)
 
     # ---------------------------------------------------------------- eval
     def evaluate(self, valid_data_loader: Iterable, global_step: int = 0):
@@ -494,7 +546,8 @@ class EagerEngine(BasicEngine):
         assert self.state is not None, "call prepare()/fit() first"
         total, count = 0.0, 0
         t0 = time.time()
-        with self._ctx():
+        with self._ctx(), self.obs.timed_span("eval",
+                                              global_step=int(global_step)):
             for i, batch in enumerate(valid_data_loader):
                 if i >= self.eval_iters:
                     break
@@ -552,12 +605,15 @@ class EagerEngine(BasicEngine):
         step = int(jax.device_get(self.state.step))
         # store the UNboxed tree: partition metadata lives in code, not in the
         # checkpoint, so restores re-shard freely onto any mesh
-        return ckpt_lib.save_checkpoint(
-            self.output_dir, step, meta.unbox(self.state),
-            meta={"consumed_samples": self._consumed_samples,
-                  "epoch": getattr(self, "_epoch", self._start_epoch),
-                  "seed": self.seed},
-            async_save=self.async_save)
+        # span only: the duration/bytes histograms live in checkpoint.py
+        # (ckpt_save/ckpt_bytes), which also covers non-engine callers
+        with self.obs.span("checkpoint_save", step=step):
+            return ckpt_lib.save_checkpoint(
+                self.output_dir, step, meta.unbox(self.state),
+                meta={"consumed_samples": self._consumed_samples,
+                      "epoch": getattr(self, "_epoch", self._start_epoch),
+                      "seed": self.seed},
+                async_save=self.async_save)
 
     def load(self, directory: Optional[str] = None):
         """Restore the latest checkpoint (reference ``eager_engine.py:617-660``).

@@ -13,12 +13,15 @@ the dp degree is the served batch.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from fleetx_tpu.observability.metrics import get_registry
+from fleetx_tpu.observability.trace import span
 from fleetx_tpu.utils.export import load_exported
 from fleetx_tpu.utils.log import logger
 
@@ -66,6 +69,9 @@ class InferenceEngine:
             self._init_tensor_parallel(model_dir)
         self._plain_call = jax.jit(self.exported.call)
         self._sharded_calls: dict = {}  # in_specs signature → jitted shard_map
+        # serving telemetry (docs/observability.md): request latencies land
+        # in the process registry; p50/p95/p99 via latency_summary()
+        self.metrics = get_registry()
         logger.info("loaded exported model from %s (dp=%d, mp=%d)",
                     model_dir, self.dp, self.mp)
 
@@ -122,6 +128,33 @@ class InferenceEngine:
         Outputs with rank >= 2 come back gathered along the batch dim,
         rank 0/1 outputs are taken from one shard.
         """
+        t0 = time.perf_counter()
+        try:
+            with span("inference_predict"):
+                out = self._predict(inputs)
+        except BaseException:
+            # failures must not pollute latency quantiles or flip the warm
+            # flag (a failed first call never compiled anything), but they
+            # DO count toward the total (error_rate = failed/total)
+            self.metrics.counter("requests_total").inc()
+            self.metrics.counter("requests_failed_total").inc()
+            raise
+        # first-call compile time lands in request_compile_latency so
+        # steady-state p99s aren't polluted by the one-off trace/compile
+        dt = time.perf_counter() - t0
+        name = "request_latency" if self._warm else "request_compile_latency"
+        self._warm = True
+        self.metrics.histogram(name).record(dt)
+        self.metrics.counter("requests_total").inc()
+        return out
+
+    _warm = False
+
+    def latency_summary(self) -> dict:
+        """p50/p95/p99 etc. of warm request latencies (seconds)."""
+        return self.metrics.histogram("request_latency").summary()
+
+    def _predict(self, inputs: Sequence[Any]) -> list[np.ndarray]:
         arrays = [np.asarray(x) for x in inputs]
         if self.mp > 1:
             # GSPMD path: the exported module is inlined into the jit, the

@@ -1,0 +1,162 @@
+"""Unified telemetry: metrics registry, span tracer, and sinks.
+
+The package the ROADMAP's "as fast as the hardware allows" goal measures
+itself with (docs/observability.md). Three layers:
+
+- ``metrics``  — counters/gauges/windowed histograms + derived
+  tokens-per-sec / step-time EWMA / data-stall / MFU arithmetic;
+- ``trace``    — ``span()`` host spans emitting Chrome-trace JSON, nested
+  under ``jax.profiler.TraceAnnotation``, plus the re-armable
+  ``ProfilerWindow`` for XLA traces;
+- ``sinks``    — rank-0-gated JSONL / CSV / Prometheus-textfile emitters.
+
+``Observability`` ties them together for the engines: built from the
+``Observability:`` YAML block (``utils/config.py``), it owns the tracer
+lifecycle, the sink fan-out and the derived-metric state, and is a cheap
+no-op when the block is absent or disabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Optional
+
+import jax
+
+from fleetx_tpu.observability.metrics import (  # noqa: F401
+    Counter, DerivedMetrics, Gauge, Histogram, MetricsRegistry, get_registry,
+    mfu)
+from fleetx_tpu.observability.sinks import (  # noqa: F401
+    CsvSink, JsonlSink, PrometheusTextfileSink, Sink, build_sinks)
+from fleetx_tpu.observability.trace import (  # noqa: F401
+    ProfilerWindow, Tracer, _process_index, get_tracer, set_tracer, span)
+from fleetx_tpu.utils.log import logger
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DerivedMetrics",
+    "get_registry", "mfu", "Sink", "JsonlSink", "CsvSink",
+    "PrometheusTextfileSink", "build_sinks", "Tracer", "ProfilerWindow",
+    "span", "get_tracer", "set_tracer", "Observability",
+]
+
+
+class Observability:
+    """Engine-facing facade over registry + tracer + sinks.
+
+    ``Observability(cfg_block)`` with a falsy/disabled block yields an
+    object whose every method is a no-op, so the engines call it
+    unconditionally and pay nothing when telemetry is off.
+    """
+
+    def __init__(self, cfg: Optional[dict] = None,
+                 default_output_dir: str = "./output"):
+        cfg = dict(cfg or {})
+        self.enabled = bool(cfg.get("enable"))
+        self.output_dir = str(cfg.get("output_dir")
+                              or os.path.join(default_output_dir, "telemetry"))
+        # explicit None checks: ewma_alpha 0 (no smoothing) is a valid value
+        alpha = cfg.get("ewma_alpha")
+        self.ewma_alpha = 0.1 if alpha is None else float(alpha)
+        # the process-wide registry: checkpoint.py and the inference path
+        # record into the same one, so engine records see their timings
+        self.registry = get_registry()
+        self.sinks: list[Sink] = []
+        self.tracer: Optional[Tracer] = None
+        self._trace_path: Optional[str] = None
+        self.derived: Optional[DerivedMetrics] = None
+        if not self.enabled:
+            return
+        window = cfg.get("histogram_window")
+        self.registry.set_default_window(1024 if window is None
+                                         else int(window))
+        self.sinks = build_sinks(cfg.get("sinks") or ["jsonl"],
+                                 self.output_dir)
+        trace_cfg = dict(cfg.get("trace") or {})
+        if trace_cfg.get("enable", True):
+            self.tracer = Tracer(
+                max_events=int(trace_cfg.get("max_events") or 200_000))
+            fname = str(trace_cfg.get("path") or "trace.json")
+            path = (fname if os.path.isabs(fname)
+                    else os.path.join(self.output_dir, fname))
+            rank = _process_index()
+            if rank:
+                # each host writes its own file (shared storage: same path
+                # from every process would clobber); merge in Perfetto by pid
+                root, ext = os.path.splitext(path)
+                path = f"{root}.rank{rank}{ext or '.json'}"
+            self._trace_path = path
+            set_tracer(self.tracer)
+        logger.info("observability enabled → %s (sinks: %s%s)",
+                    self.output_dir,
+                    [type(s).__name__ for s in self.sinks],
+                    ", tracing" if self.tracer else "")
+
+    # -- spans ---------------------------------------------------------------
+    def span(self, name: str, **args: Any):
+        """A recorded span when enabled, else a zero-cost null context."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        return span(name, **args)
+
+    def timed_span(self, name: str, **args: Any):
+        """Span composed with ``registry.timer``: one region feeds the trace,
+        the ``name`` histogram and the ``<name>_seconds_total`` counter."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        stack = contextlib.ExitStack()
+        stack.enter_context(span(name, **args))
+        stack.enter_context(self.registry.timer(name))
+        return stack
+
+    # -- derived metrics -----------------------------------------------------
+    def init_derived(self, flops_per_token: Optional[float],
+                     n_devices: int) -> None:
+        """Create the DerivedMetrics layer once the module/mesh are known."""
+        from fleetx_tpu.utils.hardware import peak_flops
+
+        self.derived = DerivedMetrics(
+            flops_per_token=flops_per_token,
+            peak_flops_per_chip=peak_flops(jax.devices()[0]),
+            n_devices=n_devices, ewma_alpha=self.ewma_alpha)
+        # the registry is process-wide: baseline the stall integral so a
+        # fresh engine's first window doesn't inherit prior engines' stalls
+        self.derived._last_stall_total = self.stall_seconds_total()
+
+    def stall_seconds_total(self) -> float:
+        """Monotone host-blocked time: data fetch + host-to-device copy."""
+        return (self.registry.counter("data_fetch_seconds_total").value
+                + self.registry.counter("shard_batch_seconds_total").value)
+
+    # -- record fan-out ------------------------------------------------------
+    def emit(self, record: dict) -> None:
+        """Fan one step record out to every sink (never raises)."""
+        if not self.enabled:
+            return
+        for sink in self.sinks:
+            try:
+                sink.emit(record)
+            except OSError as e:  # a full disk must not kill training
+                logger.warning("sink %s emit failed: %s",
+                               type(sink).__name__, e)
+
+    def flush(self) -> None:
+        """Durable-ize sinks and write the Chrome trace snapshot."""
+        if not self.enabled:
+            return
+        for sink in self.sinks:
+            sink.flush()
+        if self.tracer is not None and self._trace_path and \
+                self.tracer.events:
+            self.tracer.save(self._trace_path)
+
+    def close(self) -> None:
+        """Flush + close sinks and release the active tracer."""
+        if not self.enabled:
+            return
+        self.flush()
+        for sink in self.sinks:
+            sink.close()
+        self.sinks = []
+        if get_tracer() is self.tracer:
+            set_tracer(None)

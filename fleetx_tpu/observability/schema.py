@@ -1,0 +1,119 @@
+"""Schema validation for metrics JSONL records — stdlib only, no deps.
+
+One shared definition of "a valid step record", used by the unit tests and
+by ``tools/metrics_report.py`` (which exits non-zero on any violation so it
+can gate bench runs). Deliberately small: required keys with type sets,
+optional keys type-checked when present, unknown keys allowed (records are
+forward-extensible).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable, Optional
+
+_NUM = (int, float)
+_NULLABLE_NUM = (int, float, type(None))
+
+# key → (allowed types, required?)
+STEP_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
+    "step": ((int,), True),
+    "ts": (_NUM, True),
+    "loss": (_NUM, True),
+    "step_time": (_NUM, True),
+    "tokens_per_sec": (_NULLABLE_NUM, True),
+    "mfu": (_NULLABLE_NUM, True),  # null on chips without a peak table entry
+    "step_time_ewma": (_NUM, False),
+    "samples_per_sec": (_NUM, False),
+    "data_stall_frac": (_NUM, False),
+    "epoch": ((int,), False),
+    "lr": (_NUM, False),
+    "global_batch_size": ((int,), False),
+}
+
+
+def validate_record(record: Any) -> list[str]:
+    """Errors for one parsed record; empty list means valid."""
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, expected object"]
+    errors = []
+    for key, (types, required) in STEP_RECORD_SCHEMA.items():
+        if key not in record:
+            if required:
+                errors.append(f"missing required key {key!r}")
+            continue
+        v = record[key]
+        # bool is an int subclass; a boolean loss is a bug, not a number
+        if isinstance(v, bool) or not isinstance(v, types):
+            names = "|".join(t.__name__ for t in types)
+            errors.append(f"key {key!r}: {type(v).__name__} "
+                          f"(value {v!r}), expected {names}")
+            continue
+        if isinstance(v, float) and v != v:  # NaN never validates
+            errors.append(f"key {key!r} is NaN")
+    return errors
+
+
+def validate_lines(lines: Iterable[str],
+                   max_errors: int = 20) -> tuple[int, list[str]]:
+    """Validate JSONL text lines → (record_count, errors).
+
+    Errors carry 1-based line numbers; collection stops at ``max_errors``
+    so a totally corrupt file doesn't produce megabytes of complaints.
+    """
+    count = 0
+    errors: list[str] = []
+    for lineno, line in enumerate(lines, start=1):
+        if not line.strip():
+            continue
+        count += 1
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {lineno}: invalid JSON ({e})")
+        else:
+            errors.extend(f"line {lineno}: {msg}"
+                          for msg in validate_record(record))
+        if len(errors) >= max_errors:
+            errors.append("... (further errors suppressed)")
+            break
+    return count, errors
+
+
+def validate_jsonl(path: str, max_errors: int = 20) -> tuple[int, list[str]]:
+    with open(path) as f:
+        return validate_lines(f, max_errors=max_errors)
+
+
+def load_valid_records(path: str) -> list[dict]:
+    """Parse + validate; raises ``ValueError`` listing every violation."""
+    count, errors = validate_jsonl(path)
+    if errors:
+        raise ValueError(f"{path}: {len(errors)} schema violation(s):\n  "
+                         + "\n  ".join(errors))
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def chrome_trace_errors(trace: Any) -> list[str]:
+    """Structural check for a Chrome-trace JSON dict (Perfetto-loadable)."""
+    if not isinstance(trace, dict):
+        return [f"trace is {type(trace).__name__}, expected object"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing/invalid 'traceEvents' list"]
+    errors = []
+    for i, evt in enumerate(events):
+        if not isinstance(evt, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        for key, types in (("name", (str,)), ("ph", (str,)),
+                           ("ts", _NUM), ("pid", (int,)), ("tid", (int,))):
+            if not isinstance(evt.get(key), types):
+                errors.append(f"event {i}: bad {key!r}: {evt.get(key)!r}")
+        if evt.get("ph") == "X" and not isinstance(evt.get("dur"), _NUM):
+            errors.append(f"event {i}: complete event without numeric 'dur'")
+        if len(errors) >= 20:
+            errors.append("... (further errors suppressed)")
+            break
+    return errors

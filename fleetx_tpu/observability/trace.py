@@ -1,0 +1,220 @@
+"""Host-side span tracer emitting Chrome-trace-event JSON.
+
+Two layers, both cheap enough to leave on in production:
+
+- ``span("name")`` — a context manager / decorator that records a Chrome
+  "complete" event (``ph: "X"``) into the active ``Tracer`` AND enters
+  ``jax.profiler.TraceAnnotation``, so when a ``jax.profiler`` window is
+  open the host spans line up with the XLA timeline (the per-stage traces
+  the MPMD pipeline work, arXiv:2412.14374, uses to find bubbles).
+- ``ProfilerWindow`` — the config-gated ``jax.profiler`` trace window that
+  used to live as inline flags in ``eager_engine.fit``. The inline version
+  had two bugs this class fixes: (1) ``profiler_enabled = False`` after one
+  window made a second ``fit()`` on the same engine silently unprofilable —
+  the window is now re-armed per fit; (2) ``stop_trace`` ran without
+  draining in-flight device work, truncating the tail of the trace —
+  ``maybe_stop`` blocks on a sync value first.
+
+The Chrome JSON (``{"traceEvents": [...]}``) loads directly in
+https://ui.perfetto.dev or ``chrome://tracing``. Timestamps/durations are
+microseconds per the trace-event spec; ``pid`` is the JAX process index so
+multi-host traces merge cleanly.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+
+from fleetx_tpu.utils.log import logger
+
+
+def _process_index() -> int:
+    try:
+        return jax.process_index()
+    except RuntimeError:  # backend not initialised yet
+        return 0
+
+
+class Tracer:
+    """Collects span events; ``save()`` writes one Chrome-trace JSON file."""
+
+    def __init__(self, max_events: int = 200_000):
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._max_events = int(max_events)
+        self._dropped = 0
+
+    def add_event(self, name: str, ts_us: float, dur_us: float,
+                  args: Optional[dict] = None) -> None:
+        """Record one complete ('X') event; drops past the event cap."""
+        evt = {
+            "name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
+            "pid": _process_index(), "tid": threading.get_ident() & 0xFFFF,
+        }
+        if args:
+            evt["args"] = args
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self._dropped += 1
+                return
+            self._events.append(evt)
+
+    @property
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    def to_chrome_trace(self) -> dict:
+        """The Perfetto/chrome://tracing JSON object for all events."""
+        meta = {"dropped_events": self._dropped} if self._dropped else {}
+        return {"traceEvents": self.events, "displayTimeUnit": "ms",
+                **({"otherData": meta} if meta else {})}
+
+    def save(self, path: str) -> str:
+        """Write the trace (rank-0 file naming is the caller's concern —
+        each process writes its own events; pids disambiguate on merge)."""
+        if self._dropped:
+            logger.warning("tracer dropped %d events past the %d-event cap",
+                           self._dropped, self._max_events)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        logger.info("chrome trace written: %s (%d events — open in "
+                    "https://ui.perfetto.dev)", path, len(self._events))
+        return path
+
+
+# Active tracer: span() records into it when set. Default None keeps span()
+# at pure-TraceAnnotation cost for code paths with observability off.
+_active_tracer: Optional[Tracer] = None
+
+
+def set_tracer(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    """Install the active tracer; returns the previous one (restorable)."""
+    global _active_tracer
+    prev = _active_tracer
+    _active_tracer = tracer
+    return prev
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _active_tracer
+
+
+class span:
+    """``with span("train_step", step=3): ...`` or ``@span("load")``.
+
+    Records a complete event into the active tracer (if any) and nests the
+    region under ``jax.profiler.TraceAnnotation`` so host work is visible
+    inside XLA profiler windows. Nesting falls out of the trace-event model:
+    an inner span's ``[ts, ts+dur]`` lies within its parent's on the same
+    tid, which Perfetto renders as a nested slice.
+    """
+
+    __slots__ = ("name", "args", "_t0", "_ts", "_annotation")
+
+    def __init__(self, name: str, **args: Any):
+        self.name = name
+        self.args = args or None
+
+    def __enter__(self):
+        self._annotation = jax.profiler.TraceAnnotation(self.name)
+        self._annotation.__enter__()
+        # wall-clock anchor captured at ENTRY (multi-process traces share
+        # the epoch, and an outer span's ts always precedes its children's);
+        # duration from perf_counter for sub-µs stability
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        self._annotation.__exit__(exc_type, exc, tb)
+        tracer = _active_tracer
+        if tracer is not None:
+            tracer.add_event(self.name, self._ts * 1e6, dur * 1e6, self.args)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with span(self.name, **(self.args or {})):
+                return fn(*a, **kw)
+        return wrapper
+
+
+class ProfilerWindow:
+    """Config-gated ``jax.profiler`` trace window, re-armable per fit.
+
+    States: ``armed`` → (step >= start) → ``active`` → (step >= stop) →
+    ``done``; ``arm()`` at the top of every ``fit()`` resets ``done`` back
+    to ``armed`` so each fit gets its own window (the old inline flags
+    cleared ``profiler_enabled`` forever after one window).
+    """
+
+    def __init__(self, cfg: Optional[dict] = None):
+        prof = dict(cfg or {})
+        self.enabled = bool(prof.get("enable"))
+        sched = list(prof.get("scheduler") or [])
+
+        def _int(key, default):
+            v = prof.get(key, default)
+            return default if v is None else int(v)
+
+        self.start_step = _int("start_step", int(sched[0]) if sched else 3)
+        self.stop_step = _int("stop_step", int(sched[1]) if len(sched) > 1
+                              else self.start_step + 5)
+        self.output_dir = (prof.get("output_dir")
+                           or prof.get("profiler_log") or "./profiler_log")
+        self._active = False
+        self._done = False
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def arm(self) -> None:
+        """Reset for a new fit: a completed window may run again."""
+        self._done = False
+
+    def maybe_start(self, step: int) -> bool:
+        """Open the window when armed and ``step`` has reached start_step."""
+        if (not self.enabled or self._active or self._done
+                or step < self.start_step):
+            return False
+        jax.profiler.start_trace(self.output_dir)
+        self._active = True
+        logger.info("profiler trace started → %s", self.output_dir)
+        return True
+
+    def maybe_stop(self, step: int, sync: Any = None) -> bool:
+        """Close the window once ``step`` passes stop_step (drains first)."""
+        if not self._active or step < self.stop_step:
+            return False
+        self.stop(sync=sync)
+        return True
+
+    def stop(self, sync: Any = None) -> None:
+        """Close an open window, draining device work first so the trace
+        tail isn't truncated (the old inline stop skipped the sync)."""
+        if not self._active:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        logger.info("profiler trace written to %s", self.output_dir)

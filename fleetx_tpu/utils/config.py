@@ -29,6 +29,7 @@ __all__ = [
     "parse_args",
     "process_dist_config",
     "process_global_configs",
+    "process_observability_config",
     "print_config",
 ]
 
@@ -230,6 +231,19 @@ def process_engine_config(config: AttrDict) -> AttrDict:
     return config
 
 
+def process_observability_config(config: AttrDict) -> AttrDict:
+    """Ensure the ``Observability`` block exists (docs/observability.md).
+
+    Only ``enable`` (opt-in, default False — telemetry never surprises a
+    recipe) is materialised here so ``print_config`` shows the switch; the
+    per-knob defaults live in ONE place, ``observability.Observability``,
+    which engines also reach without ``get_config``.
+    """
+    obs = config.setdefault("Observability", AttrDict())
+    obs.setdefault("enable", False)
+    return config
+
+
 def get_config(fname: str, overrides: list[str] | None = None, show: bool = False,
                num_devices: int | None = None, auto_layout: bool = False) -> AttrDict:
     """Load + override + post-process a config (reference ``config.py:313-345``).
@@ -287,6 +301,7 @@ def get_config(fname: str, overrides: list[str] | None = None, show: bool = Fals
     process_dist_config(config, num_devices=num_devices)
     process_global_configs(config)
     process_engine_config(config)
+    process_observability_config(config)
     if show:
         print_config(config)
     return config

@@ -8,6 +8,7 @@ stdlib logging + ANSI colors (no colorlog dependency).
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 TRAIN = 21
@@ -28,10 +29,34 @@ _RESET = "\033[0m"
 
 
 class _ColorFormatter(logging.Formatter):
+    """Colorize per the HANDLER's stream, not ``sys.stderr`` globally.
+
+    The old global ``sys.stderr.isatty()`` check leaked ANSI codes into any
+    non-stderr handler whose stream was redirected to a pipe/file (and,
+    symmetrically, stripped color from a tty handler when stderr was
+    redirected). ``stream`` may be the stream itself or the owning
+    ``StreamHandler`` — passing the handler re-resolves ``handler.stream``
+    on every format, so ``setStream`` swaps are honoured.
+    """
+
+    def __init__(self, fmt=None, datefmt=None, stream=None):
+        super().__init__(fmt, datefmt)
+        self._stream = stream
+
+    def _colorize(self) -> bool:
+        stream = self._stream if self._stream is not None else sys.stderr
+        if isinstance(stream, logging.StreamHandler):
+            stream = stream.stream
+        isatty = getattr(stream, "isatty", None)
+        try:
+            return bool(isatty and isatty())
+        except ValueError:  # closed stream
+            return False
+
     def format(self, record: logging.LogRecord) -> str:
         """Inject the level color codes into the record."""
         msg = super().format(record)
-        if sys.stderr.isatty():
+        if self._colorize():
             color = _COLORS.get(record.levelname, "")
             return f"{color}{msg}{_RESET}"
         return msg
@@ -51,12 +76,33 @@ logging.setLoggerClass(_Logger)
 logger: _Logger = logging.getLogger("fleetx_tpu")  # type: ignore[assignment]
 logging.setLoggerClass(logging.Logger)
 
+def _initial_level() -> int:
+    """``FLEETX_LOG_LEVEL`` env override, honoured at import time.
+
+    Accepts standard level names (``DEBUG``/``INFO``/...), the custom
+    ``TRAIN``/``EVAL`` levels, or a numeric value; unknown values fall back
+    to INFO with a stderr note (the logger isn't configured yet).
+    """
+    raw = os.environ.get("FLEETX_LOG_LEVEL", "").strip()
+    if not raw:
+        return logging.INFO
+    if raw.isdigit():
+        return int(raw)
+    level = logging.getLevelName(raw.upper())
+    if isinstance(level, int):
+        return level
+    print(f"fleetx_tpu: unknown FLEETX_LOG_LEVEL={raw!r}, using INFO",
+          file=sys.stderr)
+    return logging.INFO
+
+
 if not logger.handlers:
     _handler = logging.StreamHandler(sys.stderr)
     _handler.setFormatter(_ColorFormatter(
-        "[%(asctime)s] [%(levelname)8s] %(message)s", datefmt="%Y-%m-%d %H:%M:%S"))
+        "[%(asctime)s] [%(levelname)8s] %(message)s",
+        datefmt="%Y-%m-%d %H:%M:%S", stream=_handler))
     logger.addHandler(_handler)
-    logger.setLevel(logging.INFO)
+    logger.setLevel(_initial_level())
     logger.propagate = False
 
 

@@ -1,0 +1,445 @@
+"""Unified telemetry subsystem (docs/observability.md): registry semantics,
+span tracer output, MFU arithmetic, sink formats, schema gating, and the
+engine-level JSONL pipeline."""
+
+import io
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from fleetx_tpu.observability import (
+    DerivedMetrics, MetricsRegistry, Observability, Tracer, mfu, set_tracer,
+    span)
+from fleetx_tpu.observability.schema import (
+    chrome_trace_errors, validate_jsonl, validate_record)
+from fleetx_tpu.observability.sinks import (
+    CsvSink, JsonlSink, PrometheusTextfileSink, build_sinks)
+
+
+# ---------------------------------------------------------------- registry
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    r.counter("steps").inc()
+    r.counter("steps").inc(4)
+    assert r.counter("steps").value == 5
+    r.gauge("loss").set(2.5)
+    assert r.gauge("loss").value == 2.5
+
+    h = r.histogram("lat", window=100)
+    for v in range(1, 101):  # 1..100
+        h.record(v)
+    s = h.summary()
+    assert s["count"] == 100 and s["min"] == 1 and s["max"] == 100
+    assert abs(s["p50"] - 50.5) < 1e-9
+    assert abs(s["p95"] - 95.05) < 1e-9
+    assert abs(s["p99"] - 99.01) < 1e-9
+
+    # same name returns the same object (get-or-create)
+    assert r.histogram("lat") is h
+
+
+def test_histogram_window_eviction_keeps_totals():
+    r = MetricsRegistry()
+    h = r.histogram("x", window=4)
+    for v in [10, 10, 10, 10, 1, 1, 1, 1]:
+        h.record(v)
+    assert h.summary()["max"] == 1  # old samples evicted
+    assert h.total_count == 8 and h.total_sum == 44.0  # totals survive
+
+
+def test_reset_semantics():
+    r = MetricsRegistry()
+    r.counter("c").inc(3)
+    r.gauge("g").set(7)
+    r.histogram("h").record(1.0)
+    r.reset_window()  # histograms only
+    assert r.histogram("h").summary() == {"count": 0}
+    assert r.counter("c").value == 3 and r.gauge("g").value == 7
+    assert r.histogram("h").total_count == 1  # window reset keeps totals
+    r.reset()  # everything
+    assert r.counter("c").value == 0 and r.gauge("g").value is None
+    assert r.histogram("h").total_count == 0
+
+
+def test_timer_records_histogram_and_total():
+    r = MetricsRegistry()
+    with r.timer("phase"):
+        pass
+    assert r.histogram("phase").summary()["count"] == 1
+    assert r.counter("phase_seconds_total").value > 0
+
+
+# ------------------------------------------------------------------ tracer
+
+def test_span_nesting_emits_valid_chrome_trace(tmp_path):
+    tracer = Tracer()
+    prev = set_tracer(tracer)
+    try:
+        with span("outer", step=1):
+            with span("inner"):
+                pass
+    finally:
+        set_tracer(prev)
+    events = tracer.events
+    names = [e["name"] for e in events]
+    assert names == ["inner", "outer"]  # spans close inner-first
+    inner, outer = events
+    # nesting: inner's [ts, ts+dur] lies within outer's on the same tid
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+    assert inner["tid"] == outer["tid"]
+    assert outer["args"] == {"step": 1}
+
+    path = tracer.save(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    assert chrome_trace_errors(trace) == []
+    assert {e["ph"] for e in trace["traceEvents"]} == {"X"}
+
+
+def test_span_as_decorator_records_event():
+    tracer = Tracer()
+    prev = set_tracer(tracer)
+    try:
+        @span("decorated")
+        def work(x):
+            return x + 1
+
+        assert work(1) == 2
+        assert work(2) == 3
+    finally:
+        set_tracer(prev)
+    assert [e["name"] for e in tracer.events] == ["decorated", "decorated"]
+
+
+def test_span_without_tracer_is_silent():
+    prev = set_tracer(None)
+    try:
+        with span("nothing"):
+            pass
+    finally:
+        set_tracer(prev)
+
+
+def test_tracer_event_cap_drops_not_grows():
+    tracer = Tracer(max_events=3)
+    for i in range(5):
+        tracer.add_event(f"e{i}", 0.0, 1.0)
+    assert len(tracer.events) == 3
+    assert tracer.to_chrome_trace()["otherData"]["dropped_events"] == 2
+
+
+# --------------------------------------------------------------------- MFU
+
+def test_mfu_matches_hand_computed_gpt_345m():
+    """GPT-345M (L=24, H=1024, S=1024, V=50304) on one v5e chip at the
+    round-5 measured 30,843.7 tokens/s (BENCHMARKS.md)."""
+    from fleetx_tpu.utils.hardware import gpt_flops_per_token
+
+    L, H, S, V = 24, 1024, 1024, 50304
+    n_params = L * 12 * H * H + V * H           # 353,501,184
+    assert n_params == 353_501_184
+    fpt = gpt_flops_per_token(L, H, S, vocab_size=V)
+    # 6N + 12·L·H·S = 2,121,007,104 + 301,989,888
+    assert fpt == 6.0 * n_params + 12.0 * L * H * S
+    assert fpt == 2_422_996_992.0
+
+    got = mfu(30_843.7, fpt, 197e12, 1)
+    expected = 30_843.7 * 2_422_996_992.0 / 197e12   # ≈ 0.3793
+    assert got == pytest.approx(expected, rel=1e-12)
+    assert 0.37 < got < 0.39
+
+    # unknown inputs → null, never zero
+    assert mfu(None, fpt, 197e12, 1) is None
+    assert mfu(30_843.7, None, 197e12, 1) is None
+    assert mfu(30_843.7, fpt, None, 1) is None
+
+
+def test_derived_metrics_ewma_and_stall_fraction():
+    d = DerivedMetrics(flops_per_token=1e9, peak_flops_per_chip=1e14,
+                       n_devices=2, ewma_alpha=0.5)
+    r1 = d.update(0.5, 16, tokens_per_sample=128, steps_in_window=2,
+                  stall_seconds_total=0.25)
+    assert r1["samples_per_sec"] == 32.0
+    assert r1["tokens_per_sec"] == 32.0 * 128
+    assert r1["step_time_ewma"] == 0.5
+    # 0.25s stalled over 2 steps × 0.5s window wall = 25%
+    assert r1["data_stall_frac"] == pytest.approx(0.25)
+    assert r1["mfu"] == pytest.approx(32.0 * 128 * 1e9 / (2 * 1e14))
+
+    r2 = d.update(0.3, 16, tokens_per_sample=128, steps_in_window=2,
+                  stall_seconds_total=0.25)  # no NEW stall time
+    assert r2["step_time_ewma"] == pytest.approx(0.5 * 0.3 + 0.5 * 0.5)
+    assert r2["data_stall_frac"] == 0.0
+
+    # non-LM module: tokens/sec and MFU are null, samples/sec still real
+    r3 = d.update(0.3, 16, tokens_per_sample=None, steps_in_window=1,
+                  stall_seconds_total=0.25)
+    assert r3["tokens_per_sec"] is None and r3["mfu"] is None
+    assert r3["samples_per_sec"] == pytest.approx(16 / 0.3)
+
+
+# ------------------------------------------------------------------- sinks
+
+def test_jsonl_and_csv_sinks_roundtrip(tmp_path):
+    rec1 = {"step": 1, "loss": 2.0, "mfu": None}
+    rec2 = {"step": 2, "loss": 1.5, "mfu": 0.4, "extra": "dropped-from-csv"}
+    jp, cp = str(tmp_path / "m.jsonl"), str(tmp_path / "m.csv")
+    js, cs = JsonlSink(jp), CsvSink(cp)
+    for r in (rec1, rec2):
+        js.emit(r)
+        cs.emit(r)
+    js.close(), cs.close()
+
+    lines = [json.loads(l) for l in open(jp)]
+    assert lines == [rec1, rec2]
+    rows = open(cp).read().splitlines()
+    assert rows[0] == "step,loss,mfu"
+    assert rows[1] == "1,2.0,"          # None → empty cell
+    assert rows[2] == "2,1.5,0.4"       # extra key projected away
+
+
+def test_prometheus_textfile_sink(tmp_path):
+    p = str(tmp_path / "m.prom")
+    s = PrometheusTextfileSink(p)
+    s.emit({"loss": 2.0, "mfu": None, "engine": "EagerEngine", "step": 3})
+    text = open(p).read()
+    assert "fleetx_loss 2.0" in text
+    assert "fleetx_step 3" in text
+    assert "engine" not in text and "mfu" not in text  # numbers only
+    # atomic rewrite: second emit replaces, not appends
+    s.emit({"loss": 1.0})
+    text = open(p).read()
+    assert "fleetx_loss 1.0" in text and "fleetx_loss 2.0" not in text
+
+
+def test_build_sinks_skips_unknown_names(tmp_path):
+    sinks = build_sinks(["jsonl", "nope"], str(tmp_path))
+    assert len(sinks) == 1 and isinstance(sinks[0], JsonlSink)
+    sinks[0].close()
+
+
+# ------------------------------------------------------------------ schema
+
+def test_schema_accepts_valid_and_rejects_malformed():
+    ok = {"step": 3, "ts": 1.0, "loss": 2.0, "step_time": 0.1,
+          "tokens_per_sec": None, "mfu": None, "unknown_extra": "fine"}
+    assert validate_record(ok) == []
+    assert validate_record({"step": 3}) != []                 # missing keys
+    bad_type = dict(ok, loss="2.0")
+    assert any("loss" in e for e in validate_record(bad_type))
+    nan = dict(ok, loss=float("nan"))
+    assert any("NaN" in e for e in validate_record(nan))
+    boolean = dict(ok, step=True)                             # bool ≠ int
+    assert any("step" in e for e in validate_record(boolean))
+
+
+def test_validate_jsonl_line_numbers(tmp_path):
+    p = tmp_path / "m.jsonl"
+    good = {"step": 1, "ts": 1.0, "loss": 2.0, "step_time": 0.1,
+            "tokens_per_sec": 10.0, "mfu": None}
+    p.write_text(json.dumps(good) + "\nnot json\n")
+    count, errors = validate_jsonl(str(p))
+    assert count == 2
+    assert len(errors) == 1 and errors[0].startswith("line 2:")
+
+
+# ----------------------------------------------------------- log satellites
+
+def test_color_formatter_follows_handler_stream():
+    from fleetx_tpu.utils.log import _ColorFormatter
+
+    class TtyIO(io.StringIO):
+        def isatty(self):
+            return True
+
+    rec = logging.LogRecord("t", logging.INFO, __file__, 1, "hello", (), None)
+    pipe_handler = logging.StreamHandler(io.StringIO())
+    fmt = _ColorFormatter("%(message)s", stream=pipe_handler)
+    assert "\033[" not in fmt.format(rec)  # pipe: no ANSI even if stderr=tty
+
+    tty_handler = logging.StreamHandler(TtyIO())
+    fmt = _ColorFormatter("%(message)s", stream=tty_handler)
+    assert fmt.format(rec).startswith("\033[")
+    # setStream swap is honoured (stream resolved per format call)
+    tty_handler.setStream(io.StringIO())
+    assert "\033[" not in fmt.format(rec)
+
+
+def test_log_level_env_override(monkeypatch):
+    from fleetx_tpu.utils.log import _initial_level
+
+    monkeypatch.delenv("FLEETX_LOG_LEVEL", raising=False)
+    assert _initial_level() == logging.INFO
+    monkeypatch.setenv("FLEETX_LOG_LEVEL", "debug")
+    assert _initial_level() == logging.DEBUG
+    monkeypatch.setenv("FLEETX_LOG_LEVEL", "TRAIN")
+    assert _initial_level() == 21
+    monkeypatch.setenv("FLEETX_LOG_LEVEL", "15")
+    assert _initial_level() == 15
+    monkeypatch.setenv("FLEETX_LOG_LEVEL", "bogus")
+    assert _initial_level() == logging.INFO
+
+
+# ------------------------------------------------------------ engine smoke
+
+VOCAB, SEQ, BATCH = 128, 32, 8
+
+
+def _obs_engine(tmp_path, devices, max_steps=4):
+    from fleetx_tpu.core.engine import EagerEngine
+    from fleetx_tpu.core.module import GPTModule
+    from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+    from fleetx_tpu.optims.optimizer import build_optimizer
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    cfg = {
+        "Model": dict(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_attention_heads=4, max_position_embeddings=SEQ,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      use_flash_attention=False, dtype="float32",
+                      param_dtype="float32"),
+        "Engine": {"max_steps": max_steps, "logging_freq": 1, "eval_freq": 0,
+                   "save_load": {"save_steps": max_steps,
+                                 "output_dir": str(tmp_path / "ckpt")}},
+        "Global": {"seed": 7},
+        "Observability": {"enable": True,
+                          "output_dir": str(tmp_path / "telemetry"),
+                          "sinks": ["jsonl", "csv", "prometheus"]},
+    }
+    module = GPTModule(cfg)
+    lr = build_lr_scheduler({"max_lr": 1e-3, "warmup_steps": 1,
+                             "decay_steps": 10})
+    opt = build_optimizer({"name": "AdamW"}, lr)
+    return EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr,
+                       mesh=build_mesh({}, devices=devices))
+
+
+def _batches(n):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        tokens = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+        out.append({
+            "tokens": tokens,
+            "position_ids": np.broadcast_to(
+                np.arange(SEQ, dtype=np.int32), (BATCH, SEQ)).copy(),
+            "labels": tokens,
+            "loss_mask": np.ones((BATCH, SEQ), np.float32)})
+    return out
+
+
+def test_engine_emits_schema_valid_jsonl_and_trace(tmp_path, devices8):
+    eng = _obs_engine(tmp_path, devices8[:1], max_steps=4)
+    losses = eng.fit(_batches(4))
+    assert len(losses) == 4
+    eng.obs.close()
+
+    # -- JSONL: one record per logging window, schema-valid, required keys
+    jsonl = tmp_path / "telemetry" / "metrics.jsonl"
+    count, errors = validate_jsonl(str(jsonl))
+    assert errors == [], errors
+    assert count == 4
+    records = [json.loads(l) for l in open(jsonl)]
+    for r in records:
+        for key in ("loss", "step_time", "tokens_per_sec", "mfu"):
+            assert key in r, (key, r)
+        assert r["mfu"] is None          # CPU: no peak-FLOPs entry → null
+        assert r["tokens_per_sec"] > 0   # 8×32 tokens / measured step time
+        assert r["engine"] == "EagerEngine"
+    assert [r["step"] for r in records] == [1, 2, 3, 4]
+    # checkpoint telemetry reached the shared registry
+    assert eng.obs.registry.counter("ckpt_saves_total").value >= 1
+    assert eng.obs.registry.gauge("ckpt_bytes").value > 0
+
+    # -- other sinks wrote too
+    assert (tmp_path / "telemetry" / "metrics.csv").exists()
+    assert "fleetx_loss" in (tmp_path / "telemetry" / "metrics.prom").read_text()
+
+    # -- Chrome trace: loadable, spans for every phase incl. checkpoint_save
+    trace = json.loads((tmp_path / "telemetry" / "trace.json").read_text())
+    assert chrome_trace_errors(trace) == []
+    names = {e["name"] for e in trace["traceEvents"]}
+    for expected in ("data_fetch", "shard_batch", "train_step",
+                     "checkpoint_save", "checkpoint_write"):
+        assert expected in names, (expected, names)
+    # nesting: checkpoint_write lies inside its checkpoint_save parent
+    saves = [e for e in trace["traceEvents"] if e["name"] == "checkpoint_save"]
+    writes = [e for e in trace["traceEvents"] if e["name"] == "checkpoint_write"]
+    s, w = saves[0], writes[0]
+    assert s["ts"] <= w["ts"] and \
+        w["ts"] + w["dur"] <= s["ts"] + s["dur"] + 1.0
+
+
+def test_metrics_report_gates_on_schema(tmp_path, devices8, capsys):
+    import tools.metrics_report as mr
+
+    eng = _obs_engine(tmp_path, devices8[:1], max_steps=3)
+    eng.fit(_batches(3))
+    eng.obs.close()
+    jsonl = str(tmp_path / "telemetry" / "metrics.jsonl")
+
+    assert mr.main([jsonl]) == 0
+    out = capsys.readouterr().out
+    assert "tokens/s" in out and "loss" in out
+
+    summary_path = str(tmp_path / "summary.json")
+    assert mr.main([jsonl, "--json", summary_path]) == 0
+    summary = json.loads(open(summary_path).read())
+    assert summary["records"] == 3 and summary["loss"]["mean"] > 0
+
+    # malformed record → non-zero exit (the bench gate)
+    bad = str(tmp_path / "bad.jsonl")
+    with open(jsonl) as f, open(bad, "w") as g:
+        g.write(f.readline())
+        g.write('{"step": "oops"}\n')
+    assert mr.main([bad]) != 0
+    # empty file → non-zero
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert mr.main([empty]) != 0
+    # missing file → non-zero
+    assert mr.main([str(tmp_path / "nope.jsonl")]) != 0
+
+
+def test_observability_disabled_is_noop(tmp_path, devices8):
+    obs = Observability(None)
+    assert not obs.enabled and obs.sinks == [] and obs.tracer is None
+    with obs.span("x"):
+        pass
+    with obs.timed_span("y"):
+        pass
+    obs.emit({"loss": 1.0})
+    obs.flush(), obs.close()
+    assert not (tmp_path / "telemetry").exists()
+
+
+def test_inference_latency_histogram(tmp_path, devices8):
+    import jax.export  # noqa: F401 — registers the lazy jax.export submodule
+    import jax.numpy as jnp
+
+    from fleetx_tpu.core.engine.inference_engine import InferenceEngine
+    from fleetx_tpu.utils.export import export_model
+
+    def fn(params, x):
+        return x * params["w"]
+
+    export_model(fn, (jnp.zeros((2, 3), jnp.float32),),
+                 str(tmp_path / "exported"), {"w": jnp.float32(2.0)},
+                 platforms=("cpu",))
+    eng = InferenceEngine(str(tmp_path / "exported"))
+    eng.metrics.reset()
+    for _ in range(3):
+        out = eng.predict([np.ones((2, 3), np.float32)])
+    np.testing.assert_allclose(out[0], 2.0)
+    assert eng.metrics.counter("requests_total").value == 3
+    # first (compile) call is tracked separately from warm requests
+    assert eng.metrics.histogram("request_compile_latency").summary()["count"] == 1
+    warm = eng.latency_summary()
+    assert warm["count"] == 2
+    assert {"p50", "p95", "p99"} <= set(warm)

@@ -2,6 +2,7 @@
 ``eager_engine.py:197-219,329-330``)."""
 
 import os
+import time
 
 import numpy as np
 
@@ -43,7 +44,21 @@ def test_profiler_trace_window(tmp_path, devices8):
              "loss_mask": np.ones((BATCH, SEQ), np.float32)}
     losses = eng.fit([batch] * 4)
     assert len(losses) == 4 and all(np.isfinite(losses))
-    assert not eng._profiling
+    assert not eng.profiler.active
     # a trace was written inside the window
     found = [f for _, _, fs in os.walk(out) for f in fs]
     assert found, f"no profiler output under {out}"
+
+    # a SECOND fit on the same engine must get its own window (the old
+    # inline flags cleared profiler_enabled forever after one window)
+    n_before = sum(len(fs) for _, _, fs in os.walk(out))
+    # jax.profiler names dump dirs with second resolution — step past the
+    # boundary so the second window can't overwrite the first
+    time.sleep(1.1)
+    eng.max_steps = 8  # resume past the first fit's ceiling
+    losses2 = eng.fit([batch] * 4)
+    assert len(losses2) == 4
+    assert not eng.profiler.active
+    n_after = sum(len(fs) for _, _, fs in os.walk(out))
+    assert n_after > n_before, \
+        f"second fit wrote no profiler output ({n_before} -> {n_after})"

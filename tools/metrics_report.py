@@ -1,0 +1,156 @@
+"""Summarize a telemetry JSONL run into a human-readable table.
+
+Usage::
+
+    python tools/metrics_report.py output/telemetry/metrics.jsonl
+    python tools/metrics_report.py run.jsonl --json summary.json
+    python tools/metrics_report.py run.jsonl --compare BENCH_SELF.json:gpt
+
+Every record is validated against the shared step-record schema
+(``fleetx_tpu/observability/schema.py``); ANY malformed record exits
+non-zero, so this tool gates bench runs — a pipeline that silently logged
+NaN losses or dropped its MFU field fails loudly here, not three rounds
+later in a BENCHMARKS.md table.
+
+``--json`` writes the summary as machine-readable JSON in the same spirit
+as the ``BENCH_*.json`` result entries (tokens/s value + step time + MFU),
+and ``--compare FILE:KEY`` diffs the run's throughput against a committed
+``BENCH_*.json`` entry.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from fleetx_tpu.observability.schema import validate_jsonl  # noqa: E402
+
+
+def _stats(values):
+    xs = [v for v in values if v is not None]
+    if not xs:
+        return None
+    xs_sorted = sorted(xs)
+    return {
+        "mean": sum(xs) / len(xs),
+        "min": xs_sorted[0],
+        "max": xs_sorted[-1],
+        "last": xs[-1],
+    }
+
+
+def summarize(records: list[dict]) -> dict:
+    steps = [r["step"] for r in records]
+    wall = (records[-1]["ts"] - records[0]["ts"]) if len(records) > 1 else 0.0
+    summary = {
+        "records": len(records),
+        "first_step": steps[0],
+        "last_step": steps[-1],
+        "wall_s": round(wall, 3),
+        "loss": _stats([r["loss"] for r in records]),
+        "step_time_s": _stats([r["step_time"] for r in records]),
+        "tokens_per_sec": _stats([r["tokens_per_sec"] for r in records]),
+        "mfu": _stats([r.get("mfu") for r in records]),
+        "data_stall_frac": _stats([r.get("data_stall_frac")
+                                   for r in records]),
+    }
+    return summary
+
+
+_ROWS = (
+    ("loss", "loss", 1.0, "{:.4f}"),
+    ("step_time_s", "step time (s)", 1.0, "{:.4f}"),
+    ("tokens_per_sec", "tokens/s", 1.0, "{:,.0f}"),
+    ("mfu", "MFU", 100.0, "{:.2f}%"),
+    ("data_stall_frac", "data stall", 100.0, "{:.2f}%"),
+)
+
+
+def print_table(summary: dict) -> None:
+    print(f"records: {summary['records']}   "
+          f"steps: {summary['first_step']} → {summary['last_step']}   "
+          f"wall: {summary['wall_s']:.1f}s")
+    header = f"{'metric':<14} {'mean':>12} {'min':>12} {'max':>12} {'last':>12}"
+    print(header)
+    print("-" * len(header))
+    for key, label, scale, fmt in _ROWS:
+        st = summary.get(key)
+        if st is None:
+            print(f"{label:<14} {'—':>12} {'—':>12} {'—':>12} {'—':>12}")
+            continue
+        cells = [fmt.format(st[k] * scale)
+                 for k in ("mean", "min", "max", "last")]
+        print(f"{label:<14} " + " ".join(f"{c:>12}" for c in cells))
+
+
+def compare(summary: dict, spec: str) -> int:
+    """``FILE:KEY`` → diff mean tokens/s against the bench entry's value."""
+    path, _, key = spec.partition(":")
+    with open(path) as f:
+        bench = json.load(f)
+    entry = bench.get("results", bench).get(key) if key else None
+    if not isinstance(entry, dict) or "value" not in entry:
+        print(f"error: no result entry {key!r} with a 'value' in {path}",
+              file=sys.stderr)
+        return 2
+    tps = summary.get("tokens_per_sec")
+    if not tps:
+        print("error: run has no tokens_per_sec to compare", file=sys.stderr)
+        return 2
+    ref = float(entry["value"])
+    ratio = tps["mean"] / ref if ref else float("inf")
+    print(f"\nvs {path}:{key} ({entry.get('metric', '?')}): "
+          f"{tps['mean']:,.0f} / {ref:,.0f} {entry.get('unit', '')} "
+          f"= {ratio:.3f}x")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate + summarize a telemetry metrics.jsonl")
+    ap.add_argument("jsonl", help="path to metrics.jsonl")
+    ap.add_argument("--json", metavar="OUT",
+                    help="also write the summary as JSON (- for stdout)")
+    ap.add_argument("--compare", metavar="FILE:KEY",
+                    help="diff tokens/s against a BENCH_*.json result entry")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.jsonl):
+        print(f"error: {args.jsonl} not found", file=sys.stderr)
+        return 2
+    count, errors = validate_jsonl(args.jsonl)
+    if errors:
+        print(f"error: {args.jsonl} failed schema validation "
+              f"({len(errors)} problem(s) in {count} record(s)):",
+              file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    if not count:
+        print(f"error: {args.jsonl} contains no records", file=sys.stderr)
+        return 1
+
+    with open(args.jsonl) as f:
+        records = [json.loads(l) for l in f if l.strip()]
+    records.sort(key=lambda r: r["step"])
+    summary = summarize(records)
+    print_table(summary)
+
+    if args.json:
+        payload = json.dumps(summary, indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    if args.compare:
+        rc = compare(summary, args.compare)
+        if rc:
+            return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
