@@ -1,94 +1,35 @@
 #!/usr/bin/env python
-"""Docstring checker (reference ``codestyle/docstring_checker.py`` — a
-349-LoC pylint plugin; this is the AST-native equivalent wired into
-pre-commit / CI by hand).
+"""Docstring checker — thin wrapper over the unified lint registry.
 
-Rules (a pragmatic subset of the reference's ten):
-- every public module, class, and function/method (no leading ``_``) has a
-  docstring;
-- docstrings start with a capital letter or a recognised reference tag and
-  end with a period, colon, or code block;
-- one-line summaries fit on the first line (no leading blank line).
-
-Usage: ``python codestyle/check_docstrings.py [paths...]`` — exits 1 with a
-report when violations are found.
+The policy (reference ``codestyle/docstring_checker.py``, a 349-LoC pylint
+plugin) now lives in ``fleetx_tpu/lint/rules/docstrings.py`` so docstring
+checks and the TPU-semantic lint share one driver, one ``# fleetx:
+noqa[rule]`` suppression syntax and one exit-code convention (0 clean,
+1 findings, 2 error).  This entry point is kept for pre-commit
+(``.pre-commit-config.yaml``) and muscle memory; it is exactly
+``python tools/lint.py --select docstrings [paths...]``.
 """
 
 from __future__ import annotations
 
-import ast
+import os
 import sys
-from pathlib import Path
 
-SKIP_NAMES = {
-    "__init__", "setup", "main",
-    # module/engine protocol hooks — documented once on the base protocol
-    # (core/module.py BasicModule, core/engine/basic_engine.py)
-    "get_model", "init_variables", "training_loss", "validation_loss",
-    "predict_step", "training_step_end", "validation_step_end",
-    "pretreating_batch", "input_spec", "fit", "evaluate", "predict",
-    "save", "load", "inference", "generate",
-}
-
-
-def check_file(path: Path) -> list[str]:
-    try:
-        tree = ast.parse(path.read_text(encoding="utf-8"))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    problems: list[str] = []
-    if not ast.get_docstring(tree) and path.name != "__init__.py":
-        problems.append(f"{path}:1: missing module docstring")
-
-    # public API surface only: module-level defs and their direct methods —
-    # nested closures are implementation detail (same stance as the
-    # reference checker's method whitelist)
-    nodes: list[ast.AST] = []
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            nodes.append(node)
-            if isinstance(node, ast.ClassDef):
-                nodes.extend(
-                    n for n in node.body
-                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
-    for node in nodes:
-        name = node.name
-        if name.startswith("_") or name in SKIP_NAMES:
-            continue
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            body = node.body
-            if body and isinstance(body[0], ast.Expr) and \
-                    isinstance(body[0].value, ast.Constant):
-                body = body[1:]  # strip docstring
-            if len(body) <= 1:
-                # one-statement accessors are self-describing (the
-                # reference checker keeps a similar whitelist)
-                continue
-        doc = ast.get_docstring(node)
-        kind = "class" if isinstance(node, ast.ClassDef) else "function"
-        if doc is None:
-            problems.append(
-                f"{path}:{node.lineno}: missing docstring on {kind} {name}")
-            continue
-        if not doc.strip():
-            problems.append(
-                f"{path}:{node.lineno}: empty docstring on {kind} {name}")
-    return problems
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main(argv: list[str]) -> int:
-    roots = [Path(p) for p in (argv or ["fleetx_tpu"])]
-    files: list[Path] = []
-    for root in roots:
-        files.extend(root.rglob("*.py") if root.is_dir() else [root])
-    problems: list[str] = []
-    for f in sorted(set(files)):
-        problems.extend(check_file(f))
-    for p in problems:
-        print(p)
-    print(f"checked {len(files)} files: {len(problems)} problems")
-    return 1 if problems else 0
+    from fleetx_tpu.lint import render_text, run_lint
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = argv or [os.path.join(root, "fleetx_tpu")]
+    # same default baseline as tools/lint.py, so the two gates agree
+    baseline = os.path.join(root, "tools", "lint_baseline.json")
+    result = run_lint(paths, root=root, select=["docstrings"],
+                      baseline_path=baseline
+                      if os.path.exists(baseline) else None)
+    print(render_text(result))
+    return 1 if result.findings else 0
 
 
 if __name__ == "__main__":

@@ -453,29 +453,39 @@ class EagerEngine(BasicEngine):
                 # async); device time shows up in the XLA trace the
                 # TraceAnnotation nests under
                 with self.obs.span("train_step", step=step):
-                    self.state, metrics = self._train_step(self.state, sharded)
+                    # donate_argnums=(0,) deletes the old state's buffers;
+                    # the explicit rebind keeps the donated->rebound
+                    # ordering visible (the one-line tuple assign was
+                    # equally safe — lint: donated-buffer-reuse docs)
+                    new_state, metrics = self._train_step(self.state, sharded)
+                    self.state = new_state
                 window += 1
                 self._consumed_samples += global_batch
                 step += 1
                 if window % self.logging_freq == 0:
-                    metrics = jax.device_get(metrics)
+                    # ONE device->host sync per logging window: fetch the
+                    # whole metrics pytree at once and convert on the host,
+                    # instead of per-key float() round-trips (lint:
+                    # host-sync-in-traced-code's loop-side cousin).
+                    # `metrics` stays a device pytree for the profiler sync.
+                    host_metrics = jax.device_get(metrics)
                     # resync with the device step counter: under the fp16
                     # scaler, overflowed steps don't advance state.step
-                    step = int(metrics.get("opt_step", step))
+                    step = int(host_metrics.get("opt_step", step))
                     now = time.time()
                     cost = (now - t_last) / self.logging_freq
                     t_last = now
-                    loss = float(metrics["loss"])
+                    loss = float(host_metrics["loss"])
                     losses.append(loss)
                     log_dict = {
                         "global_step": step, "epoch": self._epoch,
                         "batch": window,
                         "loss": loss, "train_cost": cost,
                         "global_batch_size": global_batch,
-                        "lr": float(metrics.get("lr", 0.0)),
+                        "lr": float(host_metrics.get("lr", 0.0)),
                     }
                     self.module.training_step_end(log_dict)
-                    self._emit_train_record(log_dict, metrics)
+                    self._emit_train_record(log_dict, host_metrics)
                 # profiler stop drains in-flight device work via the step's
                 # loss value so the trace tail isn't truncated
                 self.profiler.maybe_stop(step, sync=metrics.get("loss"))

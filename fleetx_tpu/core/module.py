@@ -163,6 +163,10 @@ class GPTModule(LanguageModule):
                 model_cfg["use_qat"] = True
                 if quant.get("weight_bits"):
                     model_cfg["qat_bits"] = int(quant["weight_bits"])
+                # activation width may differ from the weight width
+                # (reference paddleslim act quant config)
+                if quant.get("activation_bits"):
+                    model_cfg["qat_act_bits"] = int(quant["activation_bits"])
         self.model_cfg = config_from_dict(model_cfg)
         self.tokens_per_sample = self.model_cfg.max_position_embeddings
         super().__init__(cfg)

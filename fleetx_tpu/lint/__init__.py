@@ -1,0 +1,35 @@
+"""fleetx-lint — JAX/TPU-aware static analysis for the fleetx_tpu tree.
+
+The reference FleetX only ships a docstring checker; at TPU scale the
+dominant failure class is the *semantic* bug that tracing hides until hours
+into a pjit run (PAPERS.md: the pjit/TPUv4 scaling paper and the MPMD
+pipeline paper both call out sharding/tracing mistakes).  This package is an
+AST-based rule framework that catches those classes at commit time:
+
+- host syncs (``.item()``/``float``/``print``) inside jitted code,
+- reads of donated buffers after a ``donate_argnums`` call,
+- PRNG key reuse without an interleaved ``jax.random.split``,
+- ``PartitionSpec`` axis names that the mesh never declares,
+- Python ``if``/``while`` on traced values,
+- config keys no code consumes (and code sections no config provides),
+- plus the docstring conventions previously enforced by
+  ``codestyle/check_docstrings.py``, unified under the same registry,
+  suppression syntax and exit-code convention.
+
+Usage: ``python tools/lint.py [paths...]`` — see ``docs/static_analysis.md``.
+Suppress a single finding with ``# fleetx: noqa[rule-name] -- reason``;
+accept a legacy backlog with a baseline file (``tools/lint.py
+--write-baseline``).
+"""
+
+from fleetx_tpu.lint.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Project,
+    Rule,
+    SourceModule,
+    all_rules,
+    register,
+    run_lint,
+)
+from fleetx_tpu.lint.reporters import render_json, render_text  # noqa: F401

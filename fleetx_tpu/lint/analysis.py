@@ -1,0 +1,398 @@
+"""Shared AST analyses: import aliasing, traced-function discovery, taint.
+
+Three facts every tracing rule needs:
+
+1. *which functions are traced* — decorated with ``@jax.jit``/``@pjit`` (bare
+   or through ``functools.partial``), passed by name to a ``jax.jit(...)``
+   call (the engine idiom: ``self._train_step = jax.jit(train_step, ...,
+   donate_argnums=(0,))``), or lexically nested inside such a function;
+2. *which names hold traced values* inside one — a fixpoint taint walk from
+   the non-static parameters through assignments, where shape/dtype/ndim
+   reads and ``len``/``isinstance`` neutralise the taint (branching on a
+   shape is static and fine; branching on a value is not);
+3. *what a dotted callee resolves to* under the module's imports, so
+   ``jr.normal`` / ``from jax import random`` / ``np.asarray`` all normalise
+   to canonical ``jax.random.normal`` / ``numpy.asarray`` names.
+
+Scope note (docs/static_analysis.md): analysis is intra-procedural.  A
+helper called *from* a jitted function but defined elsewhere is not analysed
+— the rules catch the directly-jitted surface, which in this codebase is
+where every historical host-sync/branch bug has lived.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+#: attribute reads that yield static (trace-time) values, not traced arrays
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "aval",
+                "weak_type"}
+
+#: builtins whose result is static regardless of argument taint
+NEUTRAL_CALLS = {"len", "isinstance", "type", "getattr", "hasattr", "id",
+                 "repr", "str", "format"}
+
+#: dotted names that mean "jit this function"
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit",
+             "jax.experimental.pjit.pjit"}
+
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def module_aliases(module) -> dict[str, str]:
+    """:func:`import_aliases` cached on the SourceModule (immutable AST)."""
+    cached = getattr(module, "_lint_aliases", None)
+    if cached is None:
+        cached = module._lint_aliases = import_aliases(module.tree)
+    return cached
+
+
+def module_traced(module) -> list["TracedFn"]:
+    """:func:`traced_functions` cached on the SourceModule, so FX001/FX005
+    (and anything else) share one discovery walk per file."""
+    cached = getattr(module, "_lint_traced", None)
+    if cached is None:
+        cached = module._lint_traced = traced_functions(
+            module.tree, module_aliases(module))
+    return cached
+
+
+def fn_taints(tf: "TracedFn") -> set[str]:
+    """:func:`tainted_names` cached on the TracedFn (shared across rules)."""
+    cached = getattr(tf, "_taints", None)
+    if cached is None:
+        cached = tf._taints = tainted_names(tf)
+    return cached
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Local name → canonical dotted path for every import in the module."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    aliases[head] = head
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve(node: ast.AST, aliases: dict[str, str]) -> Optional[str]:
+    """Dotted path with the leading segment rewritten through the imports."""
+    path = dotted(node)
+    if path is None:
+        return None
+    head, _, rest = path.partition(".")
+    base = aliases.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+@dataclasses.dataclass
+class TracedFn:
+    """One function the linter believes XLA traces."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    static_params: frozenset = frozenset()
+    #: how it became traced: "decorator" | "jit-call" | "nested"
+    via: str = "decorator"
+    #: for jit-call form: the Assign target expression (e.g. "self._train_step")
+    bound_to: Optional[str] = None
+    #: donated positional indices from donate_argnums, if any
+    donate: tuple = ()
+
+    @property
+    def params(self) -> list[str]:
+        """All parameter names, in declaration order."""
+        a = self.node.args
+        return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _literal_ints(node: ast.AST) -> tuple:
+    """A literal int / tuple-of-ints, else ()."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return ()
+            out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _literal_strs(node: ast.AST) -> tuple:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant) and isinstance(e.value, str))
+    return ()
+
+
+def _static_from_kwargs(call: ast.Call, fn: ast.AST) -> frozenset:
+    """Parameter names made static by static_argnums/static_argnames."""
+    params = [p.arg for p in (*fn.args.posonlyargs, *fn.args.args)]
+    static: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for i in _literal_ints(kw.value):
+                if 0 <= i < len(params):
+                    static.add(params[i])
+        elif kw.arg == "static_argnames":
+            static.update(_literal_strs(kw.value))
+    return frozenset(static)
+
+
+def _positional_params(fn: ast.AST) -> list[str]:
+    """Positional parameter names of a def or lambda."""
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _donate_from_kwargs(call: ast.Call,
+                        params: Optional[list] = None) -> tuple:
+    """Donated positions from donate_argnums and — when the jitted
+    function's signature is visible — donate_argnames."""
+    nums: list[int] = []
+    names: tuple = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums.extend(_literal_ints(kw.value))
+        elif kw.arg == "donate_argnames":
+            names = _literal_strs(kw.value)
+    if names and params:
+        nums.extend(params.index(n) for n in names if n in params)
+    return tuple(sorted(set(nums)))
+
+
+def _jit_decorator(dec: ast.AST, aliases: dict[str, str],
+                   fn: ast.AST) -> Optional[TracedFn]:
+    """``@jax.jit`` / ``@partial(jax.jit, static_argnums=...)`` forms."""
+    if resolve(dec, aliases) in JIT_NAMES:
+        return TracedFn(node=fn, via="decorator")
+    if isinstance(dec, ast.Call):
+        target = resolve(dec.func, aliases)
+        if target in JIT_NAMES:
+            return TracedFn(node=fn, via="decorator",
+                            static_params=_static_from_kwargs(dec, fn),
+                            donate=_donate_from_kwargs(
+                                dec, _positional_params(fn)))
+        if target in PARTIAL_NAMES and dec.args and \
+                resolve(dec.args[0], aliases) in JIT_NAMES:
+            return TracedFn(node=fn, via="decorator",
+                            static_params=_static_from_kwargs(dec, fn),
+                            donate=_donate_from_kwargs(
+                                dec, _positional_params(fn)))
+    return None
+
+
+def traced_functions(tree: ast.AST,
+                     aliases: dict[str, str]) -> list[TracedFn]:
+    """Every function the module traces, with static/donate metadata."""
+    defs_by_name: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name[node.name] = node
+
+    traced: dict[int, TracedFn] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                tf = _jit_decorator(dec, aliases, node)
+                if tf is not None:
+                    traced[id(node)] = tf
+                    break
+
+    # jit-call form: fn passed by name to jax.jit(...), result possibly bound
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and resolve(node.func, aliases) in JIT_NAMES and node.args):
+            continue
+        head = node.args[0]
+        if not isinstance(head, ast.Name) or head.id not in defs_by_name:
+            continue
+        fn = defs_by_name[head.id]
+        traced[id(fn)] = TracedFn(
+            node=fn, via="jit-call",
+            static_params=_static_from_kwargs(node, fn),
+            donate=_donate_from_kwargs(node, _positional_params(fn)))
+
+    # lexically nested defs inherit traced-ness (their params are traced
+    # values flowing in from the enclosing trace)
+    out = list(traced.values())
+    for tf in list(out):
+        for inner in ast.walk(tf.node):
+            if inner is tf.node or id(inner) in traced:
+                continue
+            if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced[id(inner)] = TracedFn(node=inner, via="nested")
+                out.append(traced[id(inner)])
+    return out
+
+
+def donated_bindings(tree: ast.AST,
+                     aliases: dict[str, str]) -> dict[str, tuple]:
+    """Callable-expression string → donated positions, for jit-with-donation.
+
+    Covers the two repo idioms::
+
+        self._train_step = jax.jit(train_step, ..., donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch): ...
+    """
+    defs_by_name: dict[str, ast.AST] = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    bindings: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if resolve(call.func, aliases) in JIT_NAMES:
+                params = None
+                if call.args:
+                    head = call.args[0]
+                    if isinstance(head, ast.Lambda):
+                        params = _positional_params(head)
+                    elif isinstance(head, ast.Name) and \
+                            head.id in defs_by_name:
+                        params = _positional_params(defs_by_name[head.id])
+                donate = _donate_from_kwargs(call, params)
+                if donate and len(node.targets) == 1:
+                    key = ast.unparse(node.targets[0])
+                    bindings[key] = donate
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                tf = _jit_decorator(dec, aliases, node)
+                if tf is not None and tf.donate:
+                    bindings[node.name] = tf.donate
+    return bindings
+
+
+# ------------------------------------------------------------------- taint
+
+def own_statements(fn: ast.AST) -> Iterator[ast.stmt]:
+    """Statements of ``fn`` recursively, NOT descending into nested defs."""
+    stack: list[ast.stmt] = list(fn.body)
+    while stack:
+        stmt = stack.pop(0)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(stmt, field, []) or [])
+        for handler in getattr(stmt, "handlers", []) or []:
+            stack.extend(handler.body)
+
+
+def statement_exprs(stmt: ast.stmt) -> Iterator[ast.expr]:
+    """Direct expression children of a statement (no nested statements).
+
+    Pairs with :func:`own_statements`: walking each yielded statement's own
+    expressions visits every expression of a function exactly once.
+    """
+    for _, value in ast.iter_fields(stmt):
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, ast.expr):
+                    yield item
+                elif isinstance(item, (ast.withitem, ast.keyword)):
+                    yield from (v for _, v in ast.iter_fields(item)
+                                if isinstance(v, ast.expr))
+
+
+def walk_exprs(expr: ast.expr) -> Iterator[ast.AST]:
+    """``ast.walk`` over an expression, not descending into lambda bodies."""
+    stack: list[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Lambda):
+                continue
+            stack.append(child)
+
+
+def expr_taints(node: ast.AST, tainted: set[str]) -> bool:
+    """Does evaluating ``node`` touch a traced *value* (not just metadata)?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return expr_taints(node.value, tainted)
+    if isinstance(node, ast.Call):
+        fname = node.func
+        if isinstance(fname, ast.Name) and fname.id in NEUTRAL_CALLS:
+            return False
+        parts = [*node.args, *(kw.value for kw in node.keywords)]
+        if isinstance(fname, ast.Attribute):
+            parts.append(fname.value)
+        return any(expr_taints(p, tainted) for p in parts)
+    if isinstance(node, ast.Starred):
+        return expr_taints(node.value, tainted)
+    if isinstance(node, (ast.Constant, ast.Lambda)):
+        return False
+    return any(expr_taints(child, tainted)
+               for child in ast.iter_child_nodes(node)
+               if isinstance(child, ast.expr))
+
+
+def target_names(target: ast.AST) -> Iterator[str]:
+    """Simple names bound by an assignment target (tuples flattened)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from target_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from target_names(target.value)
+
+
+def tainted_names(tf: TracedFn) -> set[str]:
+    """Fixpoint of names holding traced values inside one traced function."""
+    tainted = set(tf.params) - set(tf.static_params)
+    changed = True
+    while changed:
+        changed = False
+        for stmt in own_statements(tf.node):
+            targets: list[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, ast.AugAssign):
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, ast.For):
+                targets, value = [stmt.target], stmt.iter
+            if value is not None and expr_taints(value, tainted):
+                for name in target_names(targets[0] if len(targets) == 1
+                                         else ast.Tuple(elts=targets)):
+                    if name not in tainted:
+                        tainted.add(name)
+                        changed = True
+    return tainted

@@ -1,0 +1,394 @@
+"""Rule registry, project model, suppression and baseline machinery.
+
+Design (mirrors the shape of ``observability/metrics.py``'s registry): rules
+are singletons registered by name; a :class:`Project` is built once per run
+and carries every cross-file fact a rule may need (mesh axis declarations,
+YAML config keys, the code-side consumption set); :func:`run_lint` applies
+per-module and project-wide rules, then filters findings through per-line
+``# fleetx: noqa[rule]`` suppressions and an optional baseline file.
+
+The baseline exists so a new rule can land with a legacy backlog without
+blocking CI: fingerprints are content-based (path + rule + source-line text +
+occurrence index), so unrelated edits above a finding do not invalidate it,
+while touching the flagged line itself forces a re-triage.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Any, Iterable, Iterator, Optional
+
+NOQA_RE = re.compile(r"#\s*fleetx:\s*noqa(?:\[(?P<rules>[^\]]*)\])?", re.I)
+
+#: directories (relative to the project root) whose python files define the
+#: config-consumption surface even when they are not being linted themselves
+CONSUMER_DIRS = ("fleetx_tpu", "tools", "tasks")
+
+#: directories holding the YAML config zoo checked by dead-config-key
+CONFIG_DIRS = ("fleetx_tpu/configs", "projects")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: a rule, a location, and a message."""
+
+    rule: str
+    code: str
+    path: str  # posix path relative to the project root
+    line: int
+    col: int
+    message: str
+    fingerprint: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base rule: override ``check_module`` and/or ``check_project``.
+
+    ``category`` groups rules for selection (``--select docstrings``); the
+    six TPU-semantic rules use ``lint``, the docstring rules ``docstrings``.
+    """
+
+    name: str = ""
+    code: str = ""
+    category: str = "lint"
+    description: str = ""
+    #: True for rules that read the YAML config zoo (affects the file count)
+    scans_configs: bool = False
+
+    def check_module(self, module: "SourceModule",
+                     project: "Project") -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: "Project") -> Iterable[Finding]:
+        return ()
+
+    def finding(self, path: str, line: int, col: int, message: str) -> Finding:
+        return Finding(rule=self.name, code=self.code, path=path,
+                       line=max(int(line), 1), col=int(col), message=message)
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: instantiate and register a rule by its name."""
+    rule = cls()
+    assert rule.name and rule.code, f"rule {cls.__name__} lacks name/code"
+    assert rule.name not in _REGISTRY, f"duplicate rule {rule.name}"
+    _REGISTRY[rule.name] = rule
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """Name → rule for every registered rule (imports the rule modules)."""
+    import fleetx_tpu.lint.rules  # noqa: F401 — registration side effect
+
+    return dict(sorted(_REGISTRY.items(), key=lambda kv: kv[1].code))
+
+
+def resolve_rules(select: Iterable[str] | None = None,
+                  skip: Iterable[str] | None = None) -> list[Rule]:
+    """Resolve ``--select``/``--skip`` tokens (rule name, code, or category)."""
+    rules = all_rules()
+
+    def matches(rule: Rule, token: str) -> bool:
+        return token in (rule.name, rule.code, rule.category)
+
+    def validate(tokens: list) -> list:
+        unknown = [t for t in tokens
+                   if not any(matches(r, t) for r in rules.values())]
+        if unknown:
+            raise KeyError(f"unknown rule/category selector(s): {unknown}")
+        return tokens
+
+    out = list(rules.values())
+    if select:
+        tokens = validate(list(select))
+        out = [r for r in out if any(matches(r, t) for t in tokens)]
+    if skip:
+        tokens = validate(list(skip))
+        out = [r for r in out if not any(matches(r, t) for t in tokens)]
+    return out
+
+
+class SourceModule:
+    """One parsed python file (path, text, lines, AST)."""
+
+    def __init__(self, path: Path, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)  # SyntaxError handled by the runner
+
+
+class Project:
+    """Cross-file context: scanned modules + repo-level facts for rules."""
+
+    def __init__(self, root: Path, scan_paths: list[Path]):
+        self.root = root.resolve()
+        self.scan_paths = [p.resolve() for p in scan_paths]
+        self.modules: list[SourceModule] = []
+        self.broken: list[Finding] = []  # syntax errors surfaced as findings
+        self.config_paths: list[Path] = []
+        self._lines_cache: dict[str, list[str]] = {}
+        self._mesh_axes: Optional[tuple] = None
+        self._collect()
+
+    # ------------------------------------------------------------ collection
+    def relpath(self, path: Path) -> str:
+        try:
+            return path.resolve().relative_to(self.root).as_posix()
+        except ValueError:
+            return path.as_posix()
+
+    def _collect(self) -> None:
+        py_files: list[Path] = []
+        yaml_files: list[Path] = []
+        for p in self.scan_paths:
+            if p.is_dir():
+                py_files.extend(sorted(p.rglob("*.py")))
+                yaml_files.extend(sorted(p.rglob("*.yaml")))
+                yaml_files.extend(sorted(p.rglob("*.yml")))
+            elif p.suffix == ".py":
+                py_files.append(p)
+            elif p.suffix in (".yaml", ".yml"):
+                yaml_files.append(p)
+        seen = set()
+        for f in py_files:
+            rel = self.relpath(f)
+            if rel in seen:
+                continue
+            seen.add(rel)
+            try:
+                text = f.read_text(encoding="utf-8")
+                self.modules.append(SourceModule(f, rel, text))
+            except SyntaxError as e:
+                self.broken.append(Finding(
+                    rule="syntax-error", code="FX000", path=rel,
+                    line=int(e.lineno or 1), col=int(e.offset or 0),
+                    message=f"syntax error: {e.msg}"))
+            except UnicodeDecodeError:
+                self.broken.append(Finding(
+                    rule="syntax-error", code="FX000", path=rel,
+                    line=1, col=0, message="file is not valid UTF-8"))
+            except ValueError as e:  # e.g. null bytes reach ast.parse
+                self.broken.append(Finding(
+                    rule="syntax-error", code="FX000", path=rel,
+                    line=1, col=0, message=f"unparseable source: {e}"))
+            except OSError:
+                continue
+        self.config_paths = sorted(set(yaml_files))
+
+    # ---------------------------------------------------------- shared facts
+    def line(self, relpath: str, lineno: int) -> str:
+        """Physical source line (1-indexed) of any file under the root."""
+        lines = self._lines_cache.get(relpath)
+        if lines is None:
+            try:
+                lines = (self.root / relpath).read_text(
+                    encoding="utf-8").splitlines()
+            except (OSError, UnicodeDecodeError):
+                lines = []
+            self._lines_cache[relpath] = lines
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1]
+        return ""
+
+    def mesh_axes(self) -> tuple:
+        """Mesh axis names declared by ``fleetx_tpu/parallel/mesh.py``.
+
+        Parsed statically (``MESH_AXES = (...)``) so linting never imports
+        jax; falls back to the canonical five axes when the file is absent
+        (fixture projects).
+        """
+        if self._mesh_axes is not None:
+            return self._mesh_axes
+        default = ("pipe", "data", "fsdp", "seq", "tensor")
+        mesh_py = self.root / "fleetx_tpu" / "parallel" / "mesh.py"
+        axes = None
+        if mesh_py.exists():
+            try:
+                tree = ast.parse(mesh_py.read_text(encoding="utf-8"))
+                for node in tree.body:
+                    if isinstance(node, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == "MESH_AXES"
+                            for t in node.targets):
+                        val = node.value
+                        if isinstance(val, (ast.Tuple, ast.List)):
+                            names = [e.value for e in val.elts
+                                     if isinstance(e, ast.Constant)
+                                     and isinstance(e.value, str)]
+                            if names:
+                                axes = tuple(names)
+            except (SyntaxError, OSError):
+                axes = None
+        self._mesh_axes = axes or default
+        return self._mesh_axes
+
+    def config_files(self) -> list[Path]:
+        """YAML files in scope: the config zoo dirs plus any scanned YAML."""
+        out = dict.fromkeys(self.config_paths)
+        for d in CONFIG_DIRS:
+            base = self.root / d
+            if base.is_dir():
+                for f in sorted(base.rglob("*.yaml")):
+                    out.setdefault(f.resolve())
+        return list(out)
+
+    def consumer_trees(self) -> Iterator[ast.AST]:
+        """ASTs of every python file that may consume config keys."""
+        seen: set[str] = set()
+        for m in self.modules:
+            seen.add(m.relpath)
+            yield m.tree
+        for d in CONSUMER_DIRS:
+            base = self.root / d
+            if not base.is_dir():
+                continue
+            for f in sorted(base.rglob("*.py")):
+                rel = self.relpath(f)
+                if rel in seen:
+                    continue
+                seen.add(rel)
+                try:
+                    yield ast.parse(f.read_text(encoding="utf-8"))
+                except (SyntaxError, OSError):
+                    continue
+
+
+@dataclasses.dataclass
+class LintResult:
+    """Outcome of one run: active findings plus suppression accounting."""
+
+    findings: list[Finding]
+    suppressed: list[Finding]
+    baselined: list[Finding]
+    rules: list[str]
+    files: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+# --------------------------------------------------------------- suppression
+
+def _noqa_suppresses(line: str, finding: Finding) -> bool:
+    m = NOQA_RE.search(line)
+    if not m:
+        return False
+    rules = m.group("rules")
+    if rules is None:
+        return True  # bare "fleetx: noqa" silences every rule on the line
+    tokens = {t.strip() for t in rules.split(",") if t.strip()}
+    return finding.rule in tokens or finding.code in tokens
+
+
+def fingerprint_findings(findings: list[Finding], project: Project) -> None:
+    """Content-based fingerprints: stable under line-number drift."""
+    counts: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        text = project.line(f.path, f.line).strip()
+        key = (f.path, f.rule, text)
+        idx = counts.get(key, 0)
+        counts[key] = idx + 1
+        raw = f"{f.path}::{f.rule}::{text}::{idx}"
+        f.fingerprint = hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+
+def load_baseline(path: Path) -> set[str]:
+    """Fingerprints accepted by a baseline file (missing file → empty)."""
+    if not path.exists():
+        return set()
+    with open(path) as fh:
+        data = json.load(fh)
+    return {str(fp) for fp in data.get("findings", {})}
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    """Persist current findings as the accepted backlog."""
+    payload = {
+        "version": 1,
+        "comment": "accepted legacy findings — regenerate with "
+                   "`python tools/lint.py --write-baseline`",
+        "findings": {
+            f.fingerprint: {"rule": f.rule, "path": f.path, "line": f.line,
+                            "message": f.message}
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.col))
+        },
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+# --------------------------------------------------------------------- runner
+
+def run_lint(paths: Iterable[Any], root: Any = None,
+             select: Iterable[str] | None = None,
+             skip: Iterable[str] | None = None,
+             baseline_path: Any = None) -> LintResult:
+    """Lint ``paths`` and return the filtered result.
+
+    ``root`` anchors cross-file facts (mesh axes, config zoo, consumption
+    set); it defaults to the common parent of ``paths`` so fixture projects
+    in a tmp dir are self-contained.
+    """
+    path_objs = [Path(p) for p in paths]
+    if root is None:
+        root = _common_root(path_objs)
+    project = Project(Path(root), path_objs)
+    rules = resolve_rules(select, skip)
+
+    findings: list[Finding] = list(project.broken)
+    for rule in rules:
+        findings.extend(rule.check_project(project))
+        for module in project.modules:
+            findings.extend(rule.check_module(module, project))
+    fingerprint_findings(findings, project)
+
+    accepted = load_baseline(Path(baseline_path)) if baseline_path else set()
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    baselined: list[Finding] = []
+    for f in findings:
+        if _noqa_suppresses(project.line(f.path, f.line), f):
+            suppressed.append(f)
+        elif f.fingerprint in accepted:
+            baselined.append(f)
+        else:
+            active.append(f)
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    # config files count as "checked" only when a config-reading rule ran
+    n_configs = (len(project.config_files())
+                 if any(r.scans_configs for r in rules) else 0)
+    return LintResult(findings=active, suppressed=suppressed,
+                      baselined=baselined, rules=[r.name for r in rules],
+                      files=len(project.modules) + n_configs)
+
+
+def _common_root(paths: list[Path]) -> Path:
+    resolved = [p.resolve() for p in paths] or [Path.cwd()]
+    common = resolved[0] if resolved[0].is_dir() else resolved[0].parent
+    for p in resolved[1:]:
+        p = p if p.is_dir() else p.parent
+        while common not in (p, *p.parents):
+            common = common.parent
+    # a run over fleetx_tpu/ should still see tools/ + configs at the repo
+    # root: hop up while the chosen root looks like a package subdir
+    if (common / "__init__.py").exists():
+        while (common / "__init__.py").exists():
+            common = common.parent
+    return common

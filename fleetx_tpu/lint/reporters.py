@@ -1,0 +1,50 @@
+"""Text and JSON rendering of a :class:`LintResult`.
+
+Text mimics the compiler convention (``path:line:col: CODE[rule] message``)
+so editors and CI annotations pick locations up; JSON follows the
+``tools/metrics_report.py --json`` spirit — a single machine-readable object
+a gating script can consume without scraping stdout.
+"""
+
+from __future__ import annotations
+
+from fleetx_tpu.lint.core import LintResult
+
+
+def render_text(result: LintResult, verbose: bool = False) -> str:
+    """Human-readable report, one finding per line plus a summary."""
+    out = [f"{f.location()}: {f.code}[{f.rule}] {f.message}"
+           for f in result.findings]
+    summary = (f"checked {result.files} files: {len(result.findings)} "
+               f"finding(s)")
+    extras = []
+    if result.suppressed:
+        extras.append(f"{len(result.suppressed)} noqa-suppressed")
+    if result.baselined:
+        extras.append(f"{len(result.baselined)} baselined")
+    if extras:
+        summary += f" ({', '.join(extras)})"
+    out.append(summary)
+    if verbose and result.suppressed:
+        out.append("suppressed:")
+        out.extend(f"  {f.location()}: {f.code}[{f.rule}] {f.message}"
+                   for f in result.suppressed)
+    return "\n".join(out)
+
+
+def render_json(result: LintResult) -> dict:
+    """Machine-readable payload (schema_version pins the contract)."""
+    return {
+        "schema_version": 1,
+        "rules": result.rules,
+        "files": result.files,
+        "counts": {
+            "findings": len(result.findings),
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+        },
+        "findings": [f.to_dict() for f in result.findings],
+        "suppressed": [f.to_dict() for f in result.suppressed],
+        "baselined": [f.to_dict() for f in result.baselined],
+        "clean": result.clean,
+    }

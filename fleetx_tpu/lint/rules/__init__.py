@@ -1,0 +1,10 @@
+"""Rule modules — importing this package registers every rule."""
+
+from fleetx_tpu.lint.rules import (  # noqa: F401
+    config_keys,
+    docstrings,
+    donation,
+    prng,
+    pspec,
+    tracing,
+)

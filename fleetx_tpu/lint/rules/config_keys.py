@@ -1,0 +1,214 @@
+"""dead-config-key: YAML keys no code consumes, and code sections no YAML
+provides.
+
+The config zoo (``fleetx_tpu/configs/``) outlives the code that reads it:
+a renamed engine knob leaves the old YAML key silently ignored — the recipe
+*looks* tuned but the value never lands (the classic "why did my
+save_steps stop working" failure).  Because ``AttrDict`` supports
+``cfg.get("k")``, ``cfg["k"]`` and ``cfg.k`` access, the consumption set is
+built from every python file under ``fleetx_tpu/``, ``tools/`` and
+``tasks/``: string keys of ``get/pop/setdefault``/subscript/``in`` tests,
+attribute names, keyword-argument names and function parameter names (YAML
+sub-dicts are routinely splatted ``**cfg`` into constructors), and
+class-body field names (dataclass configs).  A YAML leaf key matching none
+of those is dead.
+
+The reverse direction flags code reading a *section* no config ever
+defines: ``cfg.get("TitleCase")``/``cfg["TitleCase"]`` on a receiver named
+like a config (``cfg``/``config``/``self.cfg``...) where no YAML in the
+repo has that top-level key — the stale-rename caught from the code side.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Iterable, Optional
+
+from fleetx_tpu.lint.core import Finding, Project, Rule, register
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover — pyyaml ships with the repo
+    yaml = None
+
+#: YAML structural keys that are config-system syntax, not config data
+_STRUCTURAL = {"_base_", "_inherited_"}
+
+#: receivers that look like a config object for the reverse check
+_CFG_RECEIVERS = re.compile(
+    r"(^|\.)(cfg|config|configs|conf)$|_(cfg|config)$")
+
+_TITLECASE = re.compile(r"^[A-Z][A-Za-z0-9]+$")
+
+
+def _flatten_yaml(node: Any, path: str = "") -> Iterable[tuple[str, str, int]]:
+    """(dotted_path, leaf_key, line) for every mapping key in a YAML doc,
+    including mappings nested inside sequences (transform-op lists)."""
+    if isinstance(node, yaml.nodes.SequenceNode):
+        for item in node.value:
+            yield from _flatten_yaml(item, f"{path}[]" if path else "[]")
+        return
+    if not isinstance(node, yaml.nodes.MappingNode):
+        return
+    for key_node, value_node in node.value:
+        if not isinstance(key_node, yaml.nodes.ScalarNode):
+            continue
+        key = str(key_node.value)
+        dotted = f"{path}.{key}" if path else key
+        yield dotted, key, key_node.start_mark.line + 1
+        yield from _flatten_yaml(value_node, dotted)
+
+
+def _consumed_names(project: Project) -> set[str]:
+    """Every identifier the code could use to consume a config key."""
+    consumed: set[str] = set()
+    for tree in project.consumer_trees():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                consumed.add(node.attr)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) and \
+                        func.attr in ("get", "pop", "setdefault", "getattr"):
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        consumed.add(node.args[0].value)
+                if isinstance(func, ast.Attribute) and \
+                        func.attr == "setdefault_tree" and node.args and \
+                        isinstance(node.args[0], ast.Constant):
+                    consumed.update(str(node.args[0].value).split("."))
+                if isinstance(func, ast.Name) and func.id == "getattr" and \
+                        len(node.args) >= 2 and \
+                        isinstance(node.args[1], ast.Constant):
+                    consumed.add(str(node.args[1].value))
+                for kw in node.keywords:
+                    if kw.arg:
+                        consumed.add(kw.arg)
+            elif isinstance(node, ast.Subscript):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    consumed.add(sl.value)
+            elif isinstance(node, ast.Compare):
+                # "key" in cfg  — membership tests consume the key
+                if isinstance(node.left, ast.Constant) and \
+                        isinstance(node.left.value, str) and any(
+                            isinstance(op, (ast.In, ast.NotIn))
+                            for op in node.ops):
+                    consumed.add(node.left.value)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # configs name transforms/datasets/optimizers by the
+                # def/class they resolve to in a registry
+                consumed.add(node.name)
+                a = node.args
+                for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                    consumed.add(p.arg)
+            elif isinstance(node, ast.ClassDef):
+                consumed.add(node.name)
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and \
+                            isinstance(stmt.target, ast.Name):
+                        consumed.add(stmt.target.id)
+                    elif isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                consumed.add(t.id)
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and _TITLECASE.match(
+                        str(node.value)):
+                # TitleCase literals (section names in f-strings/dict keys)
+                consumed.add(node.value)
+    return consumed
+
+
+def _yaml_sections(project: Project) -> set[str]:
+    """Mapping keys (any depth) present in any YAML config in the repo.
+
+    All depths, because code reads nested sections through intermediate
+    dicts (``data_cfg.get("Eval")`` for ``Data.Eval``).
+    """
+    sections: set[str] = set()
+    for path in project.config_files():
+        try:
+            doc = yaml.compose(path.read_text(encoding="utf-8"))
+        except (yaml.YAMLError, OSError):
+            continue
+        for _, key, _line in _flatten_yaml(doc):
+            sections.add(key)
+    return sections
+
+
+@register
+class DeadConfigKey(Rule):
+    """Config keys and code-side sections that point at nothing."""
+
+    name = "dead-config-key"
+    code = "FX006"
+    scans_configs = True
+    description = ("YAML config key no code consumes / code reads a config "
+                   "section no YAML provides")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        if yaml is None:
+            return ()
+        out: list[Finding] = []
+        consumed = _consumed_names(project)
+
+        for path in project.config_files():
+            rel = project.relpath(path)
+            try:
+                doc = yaml.compose(path.read_text(encoding="utf-8"))
+            except (yaml.YAMLError, OSError):
+                continue
+            if doc is None:
+                continue
+            for dotted_path, key, line in _flatten_yaml(doc):
+                if key in _STRUCTURAL or key in consumed:
+                    continue
+                out.append(self.finding(
+                    rel, line, 0,
+                    f"config key '{dotted_path}' is never consumed by any "
+                    f"get()/[]/attribute access under fleetx_tpu/, tools/ "
+                    f"or tasks/ — dead key (or a renamed knob)"))
+
+        out.extend(self._unprovided_sections(project))
+        return out
+
+    # ------------------------------------------------- reverse direction
+    def _unprovided_sections(self, project: Project) -> Iterable[Finding]:
+        sections = _yaml_sections(project)
+        if not sections:  # no configs in scope — nothing to cross-check
+            return
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                section, site = self._section_read(node)
+                if section and section not in sections:
+                    yield self.finding(
+                        module.relpath, site.lineno, site.col_offset,
+                        f"code reads config section '{section}' but no YAML "
+                        f"config in the repo defines it — stale rename?")
+
+    @staticmethod
+    def _section_read(node: ast.AST) -> tuple[Optional[str], Any]:
+        """``cfg.get("X")`` / ``cfg["X"]`` with a TitleCase literal key."""
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                isinstance(node.args[0], ast.Constant):
+            receiver = node.func.value
+            key = node.args[0].value
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.slice, ast.Constant):
+            receiver = node.value
+            key = node.slice.value
+        else:
+            return None, None
+        if not isinstance(key, str) or not _TITLECASE.match(key):
+            return None, None
+        try:
+            rec_str = ast.unparse(receiver)
+        except Exception:  # pragma: no cover — malformed receivers
+            return None, None
+        if _CFG_RECEIVERS.search(rec_str):
+            return key, node
+        return None, None

@@ -1,0 +1,110 @@
+"""Docstring rules — ``codestyle/check_docstrings.py`` under the registry.
+
+Same policy as the original checker (itself a pragmatic subset of the
+reference's 349-LoC pylint plugin): public modules, classes and
+functions/methods carry docstrings; protocol hooks documented once on the
+base class and one-statement accessors are exempt.  Moving the policy here
+gives the docstring checks the shared driver, the ``# fleetx:
+noqa[docstring-missing]`` suppression syntax and the shared exit-code
+convention; ``codestyle/check_docstrings.py`` remains as a thin
+pre-commit-compatible wrapper.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from fleetx_tpu.lint.core import Finding, Project, Rule, SourceModule, register
+
+#: module/engine protocol hooks — documented once on the base protocol
+#: (core/module.py BasicModule, core/engine/basic_engine.py)
+SKIP_NAMES = {
+    "__init__", "setup", "main",
+    "get_model", "init_variables", "training_loss", "validation_loss",
+    "predict_step", "training_step_end", "validation_step_end",
+    "pretreating_batch", "input_spec", "fit", "evaluate", "predict",
+    "save", "load", "inference", "generate",
+    # lint rule protocol hooks — documented once on lint/core.py Rule
+    "check_module", "check_project",
+}
+
+
+def _public_nodes(tree: ast.Module) -> Iterable[ast.AST]:
+    """Module-level defs and their direct methods — nested closures are
+    implementation detail (same stance as the reference checker)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            yield node
+            if isinstance(node, ast.ClassDef):
+                yield from (n for n in node.body
+                            if isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)))
+
+
+def _trivial(node: ast.AST) -> bool:
+    """One-statement accessors are self-describing."""
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    body = node.body
+    if body and isinstance(body[0], ast.Expr) and \
+            isinstance(body[0].value, ast.Constant):
+        body = body[1:]  # strip docstring
+    return len(body) <= 1
+
+
+@register
+class DocstringMissing(Rule):
+    """Public module/class/function without a docstring."""
+
+    name = "docstring-missing"
+    code = "FX101"
+    category = "docstrings"
+    description = ("public module, class, or function lacks a docstring "
+                   "(protocol hooks and one-liners exempt)")
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        out: list[Finding] = []
+        if not ast.get_docstring(module.tree) and \
+                module.path.name != "__init__.py":
+            out.append(self.finding(module.relpath, 1, 0,
+                                    "missing module docstring"))
+        for node in _public_nodes(module.tree):
+            name = node.name
+            if name.startswith("_") or name in SKIP_NAMES or _trivial(node):
+                continue
+            if ast.get_docstring(node) is None:
+                kind = "class" if isinstance(node, ast.ClassDef) \
+                    else "function"
+                out.append(self.finding(
+                    module.relpath, node.lineno, node.col_offset,
+                    f"missing docstring on {kind} {name}"))
+        return out
+
+
+@register
+class DocstringEmpty(Rule):
+    """Docstring present but blank."""
+
+    name = "docstring-empty"
+    code = "FX102"
+    category = "docstrings"
+    description = "docstring exists but contains only whitespace"
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for node in _public_nodes(module.tree):
+            name = node.name
+            if name.startswith("_") or name in SKIP_NAMES or _trivial(node):
+                continue
+            doc = ast.get_docstring(node)
+            if doc is not None and not doc.strip():
+                kind = "class" if isinstance(node, ast.ClassDef) \
+                    else "function"
+                out.append(self.finding(
+                    module.relpath, node.lineno, node.col_offset,
+                    f"empty docstring on {kind} {name}"))
+        return out

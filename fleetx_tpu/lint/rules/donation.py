@@ -1,0 +1,237 @@
+"""donated-buffer-reuse: reads of an argument after jit donated its buffer.
+
+``jax.jit(..., donate_argnums=(0,))`` lets XLA alias the input buffer into
+the output (the in-place update the train loop depends on for memory), but
+the Python reference still points at the now-deleted buffer: any later read
+raises ``RuntimeError: Array has been deleted`` — or worse, on CPU test
+backends where donation is a no-op, silently reads stale values that then
+explode only on TPU.  The motivating case is the engine's
+``self._train_step = jax.jit(train_step, ..., donate_argnums=(0,))`` with
+``self.state`` threaded through the fit loop
+(``fleetx_tpu/core/engine/eager_engine.py``).
+
+Detection: for every binding of a jit-with-donation callable (assignment or
+``@partial(jax.jit, donate_argnums=...)`` decorator; ``donate_argnames``
+resolved to positions when the jitted function's signature is visible), find
+calls through that binding, take the donated positional argument expressions
+(simple names / attribute chains like ``self.state``), and flag
+
+- any *load* of the same expression after the call and before a rebind, and
+- a call inside a loop whose donated argument is never rebound in the loop
+  body (the second iteration passes a deleted buffer).
+
+A rebinding that happens in the same statement as the call (``state, m =
+step(state, b)``) is the idiomatic safe form and is not flagged.  The
+after-call scan is branch-aware: each statement contributes its *own*
+expressions in source order (compound statements only their headers),
+statements in a mutually exclusive ``if`` arm are skipped, and a store only
+silences later reads it dominates — a rebind inside ``if cond:`` does not
+excuse an unconditional read after the ``if``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from fleetx_tpu.lint import analysis
+from fleetx_tpu.lint.core import Finding, Project, Rule, SourceModule, register
+
+
+def _own_nodes(stmt: ast.stmt, expr_str: str, ctxs: tuple) -> list[ast.AST]:
+    """Name/Attribute nodes matching ``expr_str`` in the statement's OWN
+    expressions (headers only for compound statements)."""
+    out = []
+    for expr in analysis.statement_exprs(stmt):
+        for node in analysis.walk_exprs(expr):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ctxs) and \
+                    ast.unparse(node) == expr_str:
+                out.append(node)
+    return out
+
+
+def _own_loads(stmt: ast.stmt, expr_str: str) -> list[ast.AST]:
+    return _own_nodes(stmt, expr_str, (ast.Load,))
+
+
+def _own_stores(stmt: ast.stmt, expr_str: str) -> bool:
+    return bool(_own_nodes(stmt, expr_str, (ast.Store, ast.Del)))
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _ordered_statements(fn: ast.AST) -> list[ast.stmt]:
+    """The function's own statements in source order (compound statements
+    appear before their children)."""
+    return sorted(analysis.own_statements(fn),
+                  key=lambda s: (s.lineno, s.col_offset))
+
+
+def _branch_paths(fn: ast.AST) -> dict[int, tuple]:
+    """id(stmt) → tuple of ``(id(if_stmt), arm)`` ancestors.
+
+    Statements in different arms of the same ``if`` are mutually exclusive
+    — a read there never follows the donating call at runtime.  Loops,
+    ``with`` and ``try`` blocks are transparent (treated as always
+    executing), which errs toward flagging.
+    """
+    paths: dict[int, tuple] = {}
+
+    def visit(stmts, path):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            paths[id(s)] = path
+            if isinstance(s, ast.If):
+                visit(s.body, path + ((id(s), "body"),))
+                visit(s.orelse, path + ((id(s), "orelse"),))
+            elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                visit(s.body, path)
+                visit(s.orelse, path)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                visit(s.body, path)
+            elif isinstance(s, ast.Try):
+                visit(s.body, path)
+                for h in s.handlers:
+                    visit(h.body, path)
+                visit(s.orelse, path)
+                visit(s.finalbody, path)
+
+    visit(fn.body, ())
+    return paths
+
+
+def _compatible(p1: tuple, p2: tuple) -> bool:
+    """Can both statements execute in one run (no conflicting if-arms)?"""
+    arms = dict(p1)
+    return all(arms.get(if_id, arm) == arm for if_id, arm in p2)
+
+
+def _enclosing_loop(call_stmt: ast.stmt, fn: ast.AST) -> Optional[ast.stmt]:
+    """Innermost For/While containing ``call_stmt`` (lexically)."""
+    best = None
+    for loop in analysis.own_statements(fn):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        if loop.lineno <= call_stmt.lineno and \
+                (loop.end_lineno or loop.lineno) >= (call_stmt.end_lineno or
+                                                     call_stmt.lineno):
+            if best is None or loop.lineno >= best.lineno:
+                best = loop
+    return best
+
+
+@register
+class DonatedBufferReuse(Rule):
+    """Reads of a donated argument after the donating call."""
+
+    name = "donated-buffer-reuse"
+    code = "FX002"
+    description = ("argument read after being passed to a donate_argnums "
+                   "jit call — the buffer is deleted (or stale on CPU)")
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        aliases = analysis.module_aliases(module)
+        bindings = analysis.donated_bindings(module.tree, aliases)
+        if not bindings:
+            return ()
+        out: list[Finding] = []
+        for fn in _functions(module.tree):
+            stmts = _ordered_statements(fn)
+            paths = _branch_paths(fn)
+            for stmt in stmts:
+                for expr in analysis.statement_exprs(stmt):
+                    for node in analysis.walk_exprs(expr):
+                        if isinstance(node, ast.Call):
+                            key = ast.unparse(node.func)
+                            donate = bindings.get(key)
+                            if donate:
+                                out.extend(self._check_call(
+                                    module, fn, stmts, paths, stmt, node,
+                                    donate))
+        return out
+
+    def _check_call(self, module: SourceModule, fn: ast.AST,
+                    stmts: list[ast.stmt], paths: dict, call_stmt: ast.stmt,
+                    call: ast.Call, donate: tuple) -> Iterable[Finding]:
+        for pos in donate:
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            if not isinstance(arg, (ast.Name, ast.Attribute)):
+                continue
+            expr_str = ast.unparse(arg)
+            # reads later in the SAME statement: Python evaluates the RHS
+            # left to right, so `out = f(state, b) + state.sum()` reads the
+            # deleted buffer — and the tuple-target store happens only
+            # after the whole RHS, so it is no excuse
+            later = [n for n in _own_loads(call_stmt, expr_str)
+                     if (n.lineno, n.col_offset) > (call.end_lineno or
+                                                    call.lineno,
+                                                    call.end_col_offset or 0)]
+            if later:
+                node = later[0]
+                yield self.finding(
+                    module.relpath, node.lineno, node.col_offset,
+                    f"'{expr_str}' was donated to "
+                    f"'{ast.unparse(call.func)}' earlier in this statement "
+                    f"and read again after the call — the buffer is "
+                    f"deleted after donation")
+                continue
+            # the call statement's own stores: `state, m = step(state, b)`
+            rebound_here = _own_stores(call_stmt, expr_str)
+            loop = _enclosing_loop(call_stmt, fn)
+
+            if loop is not None and not rebound_here:
+                loop_stmts = [s for s in stmts
+                              if loop.lineno < s.lineno and
+                              (s.end_lineno or s.lineno) <=
+                              (loop.end_lineno or loop.lineno)]
+                if not any(_own_stores(s, expr_str) for s in loop_stmts):
+                    yield self.finding(
+                        module.relpath, call.lineno, call.col_offset,
+                        f"'{expr_str}' is donated here but never rebound in "
+                        f"the enclosing loop — the next iteration passes a "
+                        f"deleted buffer (rebind '{expr_str}' from the "
+                        f"call's result)")
+                    continue
+
+            if rebound_here:
+                continue
+            # branch-aware linear scan in source order over each
+            # statement's own expressions: a read is a hazard when it can
+            # execute after the call (compatible if-arms) and no store
+            # that DOMINATES it (executes on every path to it) intervened
+            call_path = paths.get(id(call_stmt), ())
+            store_paths: list[tuple] = []
+            for stmt in stmts:
+                if (stmt.lineno, stmt.col_offset) <= (call_stmt.lineno,
+                                                      call_stmt.col_offset):
+                    continue
+                p = paths.get(id(stmt))
+                if p is None or not _compatible(call_path, p):
+                    continue  # mutually exclusive with the call
+                loads = _own_loads(stmt, expr_str)
+                # `x = f(x)`: the RHS load happens before the target store,
+                # so loads are checked first
+                if loads and not any(set(sp) <= set(p)
+                                     for sp in store_paths):
+                    node = loads[0]
+                    yield self.finding(
+                        module.relpath, node.lineno, node.col_offset,
+                        f"'{expr_str}' was donated to '"
+                        f"{ast.unparse(call.func)}' on line {call.lineno} "
+                        f"and read here before being rebound — the buffer "
+                        f"is deleted after donation")
+                    break
+                if _own_stores(stmt, expr_str):
+                    if set(p) <= set(call_path):
+                        break  # unconditional rebind: everything after is safe
+                    store_paths.append(p)  # conditional rebind: keep scanning

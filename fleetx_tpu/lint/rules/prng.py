@@ -1,0 +1,150 @@
+"""prng-key-reuse: one key consumed by two sampling calls without a split.
+
+JAX keys are pure values — sampling twice with the same key yields the
+*same* bits, which in a training loop means correlated dropout masks or
+identical noise across what should be independent draws.  The repo idiom
+(``models/imagen/modeling.py``, ``models/gpt/generation.py``) is
+``rng, sub = jax.random.split(rng)`` before every consumption; this rule
+flags the paths that skip it.
+
+Detection is a per-function walk that tracks, for each simple name, the
+last sampling call that consumed it; any second consumption before the name
+is reassigned (by ``split``/``fold_in`` or anything else) is flagged.
+Branches of an ``if`` are walked with independent copies of the state and
+merged conservatively; loop bodies are walked twice so a consumption that
+survives an iteration (key never re-split in the loop) is caught.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from fleetx_tpu.lint import analysis
+from fleetx_tpu.lint.core import Finding, Project, Rule, SourceModule, register
+
+#: jax.random functions that do NOT consume a key's stream
+_NON_CONSUMING = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                  "wrap_key_data", "key_impl", "clone"}
+
+
+def _consumed_key(call: ast.Call, aliases: dict) -> Optional[str]:
+    """Name of the key a ``jax.random.*`` sampling call consumes, if any."""
+    resolved = analysis.resolve(call.func, aliases)
+    if not resolved or not resolved.startswith("jax.random."):
+        return None
+    fn_name = resolved[len("jax.random."):]
+    if "." in fn_name or fn_name in _NON_CONSUMING:
+        return None
+    key_arg = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "key":
+            key_arg = kw.value
+    if isinstance(key_arg, ast.Name):
+        return key_arg.id
+    return None
+
+
+@register
+class PrngKeyReuse(Rule):
+    """The same PRNG key consumed twice without an interleaved split."""
+
+    name = "prng-key-reuse"
+    code = "FX003"
+    description = ("a jax.random key consumed by two sampling calls without "
+                   "jax.random.split/fold_in in between — identical bits")
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        aliases = analysis.module_aliases(module)
+        out: list[Finding] = []
+        flagged: set[int] = set()  # call node ids (loop bodies walk twice)
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_block(node.body, {}, aliases, module, out, flagged)
+        return out
+
+    # ------------------------------------------------------------ the walk
+    def _walk_block(self, stmts: list[ast.stmt], state: dict,
+                    aliases: dict, module: SourceModule,
+                    out: list[Finding], flagged: set[int]) -> dict:
+        """``state``: key name → lineno of its last consumption."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, walked by check_module
+            # consumptions in this statement's own expressions, in order
+            for expr in analysis.statement_exprs(stmt):
+                for node in analysis.walk_exprs(expr):
+                    if isinstance(node, ast.Call):
+                        key = _consumed_key(node, aliases)
+                        if key is None:
+                            continue
+                        if key in state and id(node) not in flagged:
+                            flagged.add(id(node))
+                            out.append(self.finding(
+                                module.relpath, node.lineno, node.col_offset,
+                                f"key '{key}' was already consumed by a "
+                                f"sampling call on line {state[key]} — "
+                                f"split it first (rng, sub = jax.random."
+                                f"split(rng)) or the two draws return "
+                                f"identical bits"))
+                        state[key] = node.lineno
+            # rebinds reset the key's stream
+            for name in _stmt_stores(stmt):
+                state.pop(name, None)
+            # control flow
+            if isinstance(stmt, ast.If):
+                s_body = self._walk_block(stmt.body, dict(state), aliases,
+                                          module, out, flagged)
+                s_else = self._walk_block(stmt.orelse, dict(state), aliases,
+                                          module, out, flagged)
+                state = _merge(state, s_body, s_else)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # walk twice: a key consumed in iteration 1 and not re-split
+                # is reused in iteration 2
+                state = self._walk_block(stmt.body, state, aliases, module,
+                                         out, flagged)
+                state = self._walk_block(stmt.body, state, aliases, module,
+                                         out, flagged)
+                state = self._walk_block(stmt.orelse, state, aliases, module,
+                                         out, flagged)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                state = self._walk_block(stmt.body, state, aliases, module,
+                                         out, flagged)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, *(h.body for h in stmt.handlers),
+                              stmt.orelse, stmt.finalbody):
+                    state = self._walk_block(block, state, aliases, module,
+                                             out, flagged)
+        return state
+
+
+def _stmt_stores(stmt: ast.stmt) -> list[str]:
+    """Simple names this statement's own targets (re)bind."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    out: list[str] = []
+    for t in targets:
+        out.extend(analysis.target_names(t))
+    return out
+
+
+def _merge(before: dict, s_body: dict, s_else: dict) -> dict:
+    """Post-``if`` state: the union of both arms' final states.
+
+    If either arm's final state leaves the key consumed, the path through
+    that arm reaches any later consumption with the key already spent — so
+    the later draw is a real reuse on that path and must flag.  Refreshes
+    are already applied inside each arm's walk (assignment pops the key),
+    so a key re-split in an arm simply drops out of that arm's state.
+    """
+    merged = dict(s_else)
+    for key, line in s_body.items():
+        merged[key] = max(line, merged.get(key, line))
+    return merged

@@ -1,0 +1,70 @@
+"""pspec-mesh-mismatch: PartitionSpec axis literals the mesh never declares.
+
+A ``PartitionSpec("modle")`` typo does not fail at construction — GSPMD
+only rejects it when the jit actually binds the spec to a mesh, which for a
+cold-start 175B config is minutes into compilation (and under
+``shard_map`` it can silently mean "replicated").  The mesh's axis
+vocabulary is a closed set declared once (``fleetx_tpu/parallel/mesh.py``:
+``MESH_AXES``), so the check is purely static: every string literal inside
+a ``PartitionSpec(...)`` / ``P(...)`` call (including nested tuples like
+``P(("data", "fsdp"))``) must be a declared axis name.
+
+Logical axis names (``nn.with_logical_partitioning``) are out of scope —
+they pass through the rule table in ``parallel/sharding.py`` and never
+reach a ``PartitionSpec`` literal directly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from fleetx_tpu.lint import analysis
+from fleetx_tpu.lint.core import Finding, Project, Rule, SourceModule, register
+
+_PSPEC_NAMES = {"jax.sharding.PartitionSpec",
+                "jax.interpreters.pxla.PartitionSpec",
+                "jax.experimental.pjit.PartitionSpec",
+                "PartitionSpec"}
+
+
+def _axis_literals(node: ast.AST) -> Iterable[tuple[str, ast.AST]]:
+    """String constants inside a PartitionSpec argument (tuples flattened)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _axis_literals(e)
+
+
+@register
+class PSpecMeshMismatch(Rule):
+    """PartitionSpec axis-name literals cross-checked against MESH_AXES."""
+
+    name = "pspec-mesh-mismatch"
+    code = "FX004"
+    description = ("PartitionSpec axis literal not declared in "
+                   "parallel/mesh.py MESH_AXES — fails at jit bind time")
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        aliases = analysis.module_aliases(module)
+        axes = set(project.mesh_axes())
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = analysis.resolve(node.func, aliases)
+            if resolved not in _PSPEC_NAMES:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                for name, lit in _axis_literals(arg):
+                    if name not in axes:
+                        out.append(self.finding(
+                            module.relpath, lit.lineno, lit.col_offset,
+                            f"PartitionSpec axis '{name}' is not a mesh "
+                            f"axis — declared axes are "
+                            f"{tuple(project.mesh_axes())} "
+                            f"(parallel/mesh.py MESH_AXES)"))
+        return out

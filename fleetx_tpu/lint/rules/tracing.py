@@ -1,0 +1,117 @@
+"""Tracing-semantics rules: host syncs and Python control flow under jit.
+
+Both rules share the traced-function discovery and taint analysis in
+``lint/analysis.py``.  The failure modes they target are the two that the
+pjit scaling papers (PAPERS.md) call the dominant silent-slowdown class:
+
+- a ``.item()``/``float()``/``print`` on a traced value either fails at
+  trace time (``ConcretizationTypeError``) or — worse, on a re-trace path —
+  forces a device→host transfer every step;
+- a Python ``if``/``while`` on a traced value triggers per-branch re-tracing
+  (or a trace error), where ``jnp.where``/``lax.cond``/``lax.while_loop``
+  keeps control flow on-device.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from fleetx_tpu.lint import analysis
+from fleetx_tpu.lint.core import Finding, Project, Rule, SourceModule, register
+
+#: numpy call names that materialise a host array from a traced value
+_NUMPY_MATERIALIZERS = {"asarray", "array", "copy"}
+
+#: python builtins that force a concrete scalar
+_SCALAR_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+def _own_calls(tf: analysis.TracedFn) -> Iterable[ast.Call]:
+    for stmt in analysis.own_statements(tf.node):
+        for expr in analysis.statement_exprs(stmt):
+            for node in analysis.walk_exprs(expr):
+                if isinstance(node, ast.Call):
+                    yield node
+
+
+@register
+class HostSyncInTracedCode(Rule):
+    """Device→host syncs inside jitted/pjitted functions."""
+
+    name = "host-sync-in-traced-code"
+    code = "FX001"
+    description = (".item()/float()/np.asarray/jax.device_get/print on a "
+                   "traced value inside a jitted function")
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        aliases = analysis.module_aliases(module)
+        out: list[Finding] = []
+        for tf in analysis.module_traced(module):
+            tainted = analysis.fn_taints(tf)
+            for call in _own_calls(tf):
+                msg = self._diagnose(call, tainted, aliases)
+                if msg:
+                    out.append(self.finding(module.relpath, call.lineno,
+                                            call.col_offset, msg))
+        return out
+
+    def _diagnose(self, call: ast.Call, tainted: set,
+                  aliases: dict) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in ("item", "tolist"):
+            if not call.args and analysis.expr_taints(func.value, tainted):
+                return (f"'.{func.attr}()' on a traced value forces a "
+                        "device->host sync inside a jitted function")
+        if isinstance(func, ast.Name) and func.id in _SCALAR_BUILTINS:
+            if len(call.args) == 1 and \
+                    analysis.expr_taints(call.args[0], tainted):
+                return (f"'{func.id}()' concretises a traced value (host "
+                        "sync / ConcretizationTypeError) — keep it a jnp "
+                        "array or move the conversion outside jit")
+        resolved = analysis.resolve(func, aliases)
+        if resolved and resolved.startswith("numpy."):
+            tail = resolved.rsplit(".", 1)[-1]
+            if tail in _NUMPY_MATERIALIZERS and any(
+                    analysis.expr_taints(a, tainted) for a in call.args):
+                return (f"'{resolved}' materialises a traced value on the "
+                        "host inside a jitted function — use jnp instead")
+        if resolved == "jax.device_get" and any(
+                analysis.expr_taints(a, tainted) for a in call.args):
+            return ("'jax.device_get' on a traced value inside a jitted "
+                    "function is a host sync — return the value instead")
+        if isinstance(func, ast.Name) and func.id == "print":
+            if any(analysis.expr_taints(a, tainted) for a in call.args):
+                return ("'print' of a traced value prints a tracer (and "
+                        "pins a host sync on concrete re-runs) — use "
+                        "jax.debug.print")
+        return None
+
+
+@register
+class TracedPythonBranch(Rule):
+    """Python ``if``/``while`` on values derived from traced parameters."""
+
+    name = "traced-python-branch"
+    code = "FX005"
+    description = ("Python control flow on a traced value re-traces per "
+                   "branch — use jnp.where/lax.cond/lax.while_loop")
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for tf in analysis.module_traced(module):
+            tainted = analysis.fn_taints(tf)
+            for stmt in analysis.own_statements(tf.node):
+                if isinstance(stmt, (ast.If, ast.While)) and \
+                        analysis.expr_taints(stmt.test, tainted):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    fix = ("jnp.where/jax.lax.cond" if kind == "if"
+                           else "jax.lax.while_loop/jax.lax.fori_loop")
+                    out.append(self.finding(
+                        module.relpath, stmt.lineno, stmt.col_offset,
+                        f"Python '{kind}' on a traced value inside a jitted "
+                        f"function (re-traces per branch, or fails on "
+                        f"abstract values) — use {fix}"))
+        return out

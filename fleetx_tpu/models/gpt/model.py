@@ -73,7 +73,8 @@ class GPTConfig:
     # chunks of this size instead of materialising [b, s, vocab] logits
     vocab_chunk: Optional[int] = None
     use_qat: bool = False      # int8 fake-quant on linears (ops/quantization.py)
-    qat_bits: int = 8
+    qat_bits: int = 8          # weight fake-quant width (Quantization.weight_bits)
+    qat_act_bits: int = 8      # activation width (Quantization.activation_bits)
     moe_num_experts: int = 0   # 0 = dense FFN; >0 = MoE (models/gpt/moe.py)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -184,7 +185,7 @@ class MultiHeadAttention(nn.Module):
             # matmul operands; per-channel scales over the input dim
             from fleetx_tpu.ops.quantization import fake_quant
 
-            x = fake_quant(x, cfg.qat_bits)
+            x = fake_quant(x, cfg.qat_act_bits)
             qkv_k = fake_quant(qkv_k, cfg.qat_bits, axis=0)
         qkv = jnp.einsum("bsh,hcnd->bcsnd", x, qkv_k)
         qkv = qkv + qkv_bias.astype(cfg.dtype)[:, None, :, :]
@@ -219,7 +220,7 @@ class MultiHeadAttention(nn.Module):
         if cfg.use_qat:
             from fleetx_tpu.ops.quantization import fake_quant
 
-            attn_out = fake_quant(attn_out, cfg.qat_bits)
+            attn_out = fake_quant(attn_out, cfg.qat_act_bits)
             out_k = fake_quant(out_k, cfg.qat_bits, axis=(0, 1))
         out = jnp.einsum("bsnd,ndh->bsh", attn_out, out_k)
         out = out + out_bias.astype(cfg.dtype)
@@ -322,7 +323,7 @@ class GPTMlp(nn.Module):
         if cfg.use_qat:
             from fleetx_tpu.ops.quantization import fake_quant
 
-            x = fake_quant(x, cfg.qat_bits)
+            x = fake_quant(x, cfg.qat_act_bits)
             wi_k = fake_quant(wi_k, cfg.qat_bits, axis=0)
             wo_k = fake_quant(wo_k, cfg.qat_bits, axis=0)
         y = jnp.einsum("bsh,hm->bsm", x, wi_k) + bi.astype(cfg.dtype)
@@ -331,7 +332,7 @@ class GPTMlp(nn.Module):
         if cfg.use_qat:
             from fleetx_tpu.ops.quantization import fake_quant
 
-            y = fake_quant(y, cfg.qat_bits)
+            y = fake_quant(y, cfg.qat_act_bits)
         return jnp.einsum("bsm,mh->bsh", y, wo_k) + bo.astype(cfg.dtype)
 
 

@@ -179,6 +179,9 @@ class ProfilerWindow:
                               else self.start_step + 5)
         self.output_dir = (prof.get("output_dir")
                            or prof.get("profiler_log") or "./profiler_log")
+        # reference Profiler's "detailed" flag: also emit a standalone
+        # perfetto trace file next to the xplane dump
+        self.detailed = bool(prof.get("detailed"))
         self._active = False
         self._done = False
 
@@ -195,7 +198,8 @@ class ProfilerWindow:
         if (not self.enabled or self._active or self._done
                 or step < self.start_step):
             return False
-        jax.profiler.start_trace(self.output_dir)
+        jax.profiler.start_trace(self.output_dir,
+                                 create_perfetto_trace=self.detailed)
         self._active = True
         logger.info("profiler trace started → %s", self.output_dir)
         return True

@@ -1,0 +1,686 @@
+"""fleetx-lint coverage: every rule positive + negative + noqa, the
+suppression/baseline machinery, the unified docstring checker, and the
+whole-repo gate (``python tools/lint.py fleetx_tpu/`` must stay clean — the
+CI contract from docs/static_analysis.md)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from fleetx_tpu.lint import (all_rules, render_json, render_text, run_lint)
+from fleetx_tpu.lint.core import load_baseline, write_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.lint
+
+
+def _lint_src(tmp_path, src, select=None, name="mod.py", **kw):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(src))
+    return run_lint([path], root=tmp_path, select=select, **kw)
+
+
+def _rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_has_all_rules():
+    rules = all_rules()
+    for name in ("host-sync-in-traced-code", "donated-buffer-reuse",
+                 "prng-key-reuse", "pspec-mesh-mismatch",
+                 "traced-python-branch", "dead-config-key",
+                 "docstring-missing", "docstring-empty"):
+        assert name in rules, name
+    codes = [r.code for r in rules.values()]
+    assert len(codes) == len(set(codes)), "duplicate rule codes"
+
+
+def test_select_unknown_rule_raises(tmp_path):
+    with pytest.raises(KeyError):
+        _lint_src(tmp_path, '"""Doc."""\n', select=["no-such-rule"])
+
+
+# ------------------------------------------------- host-sync-in-traced-code
+
+HOST_SYNC_POS = '''
+    """Doc."""
+    import jax
+
+    @jax.jit
+    def step(x):
+        """Doc."""
+        return float(x) + 1
+'''
+
+HOST_SYNC_VARIANTS = '''
+    """Doc."""
+    import jax
+    import numpy as np
+
+    def make(fn):
+        """Doc."""
+        return fn
+
+    def outer(state):
+        """Doc."""
+        def inner(s, b):
+            y = s + b
+            print("dbg", y)
+            np.asarray(y)
+            jax.device_get(y)
+            y.item()
+            return y
+        return jax.jit(inner, donate_argnums=())
+'''
+
+
+def test_host_sync_positive(tmp_path):
+    res = _lint_src(tmp_path, HOST_SYNC_POS,
+                    select=["host-sync-in-traced-code"])
+    assert _rules_of(res) == ["host-sync-in-traced-code"]
+
+
+def test_host_sync_jit_call_form_and_variants(tmp_path):
+    res = _lint_src(tmp_path, HOST_SYNC_VARIANTS,
+                    select=["host-sync-in-traced-code"])
+    # print / np.asarray / device_get / .item() inside the jitted inner fn
+    assert len(res.findings) == 4
+
+
+def test_host_sync_negative_outside_jit_and_static(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def host_loop(metrics):
+            """Not traced: float() here is fine."""
+            return float(metrics["loss"])
+
+        @jax.jit
+        def step(x):
+            """Shape reads are static, not syncs."""
+            n = int(x.shape[0])
+            return x * n
+    ''', select=["host-sync-in-traced-code"])
+    assert res.findings == []
+
+
+def test_host_sync_noqa(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        @jax.jit
+        def step(x):
+            """Doc."""
+            return float(x)  # fleetx: noqa[host-sync-in-traced-code] -- ok
+    ''', select=["host-sync-in-traced-code"])
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+# ----------------------------------------------------- donated-buffer-reuse
+
+def test_donated_buffer_read_after_call(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train(state, batch):
+            """Doc."""
+            return state + batch
+
+        def bad(state, b):
+            """Doc."""
+            out = train(state, b)
+            return state.sum()
+    ''', select=["donated-buffer-reuse"])
+    assert _rules_of(res) == ["donated-buffer-reuse"]
+
+
+def test_donated_buffer_loop_without_rebind(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def fit(self, batches):
+            """Engine idiom: jit-call binding + loop."""
+            self._step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+            for b in batches:
+                out = self._step(self.state, b)
+            return out
+    ''', select=["donated-buffer-reuse"])
+    assert _rules_of(res) == ["donated-buffer-reuse"]
+    assert "never rebound" in res.findings[0].message
+
+
+def test_donated_buffer_same_statement_rebind_ok(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def fit(self, batches):
+            """The safe idiom the engine uses."""
+            self._step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+            for b in batches:
+                self.state, m = self._step(self.state, b)
+            return self.state
+    ''', select=["donated-buffer-reuse"])
+    assert res.findings == []
+
+
+def test_donated_buffer_noqa(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train(state, batch):
+            """Doc."""
+            return state + batch
+
+        def bad(state, b):
+            """Doc."""
+            out = train(state, b)
+            return state.sum()  # fleetx: noqa[FX002] -- cpu-only test path
+    ''', select=["donated-buffer-reuse"])
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+def test_donated_buffer_rebind_in_compound_statement_ok(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train(state, batch):
+            """Doc."""
+            return state + batch
+
+        def ok(state, b, flag):
+            """Rebind inside the if body precedes the read."""
+            out = train(state, b)
+            if flag:
+                state = out
+                return state.sum()
+            return out
+    ''', select=["donated-buffer-reuse"])
+    assert res.findings == []
+
+
+def test_donated_buffer_exclusive_branches_not_flagged(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(state, batch):
+            """Doc."""
+            return state + batch
+
+        def exclusive(state, b, cond):
+            """Call in one arm, read in the sibling arm."""
+            if cond:
+                s2, m = f(state, b)
+                return s2
+            else:
+                return state.x
+    ''', select=["donated-buffer-reuse"])
+    assert res.findings == []
+
+
+def test_donated_buffer_conditional_rebind_still_flagged(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(state, batch):
+            """Doc."""
+            return state + batch
+
+        def bad(state, b, cond):
+            """A rebind behind `if cond:` leaves the cond=False path reading
+            the deleted buffer."""
+            s2 = f(state, b)
+            if cond:
+                state = s2
+            return state.x
+    ''', select=["donated-buffer-reuse"])
+    assert _rules_of(res) == ["donated-buffer-reuse"]
+
+
+def test_donated_buffer_read_later_in_same_statement(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(state, batch):
+            """Doc."""
+            return state + batch
+
+        def bad(state, b):
+            """RHS evaluates left-to-right: the second read is deleted."""
+            out = f(state, b) + state.sum()
+            return out
+    ''', select=["donated-buffer-reuse"])
+    assert _rules_of(res) == ["donated-buffer-reuse"]
+    assert "earlier in this statement" in res.findings[0].message
+
+
+def test_donate_argnames_resolved_to_positions(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def train(state, batch):
+            """Doc."""
+            return state + batch
+
+        def bad(state, b):
+            """Doc."""
+            step = jax.jit(train, donate_argnames=('state',))
+            out = step(state, b)
+            return state.sum()
+    ''', select=["donated-buffer-reuse"])
+    assert _rules_of(res) == ["donated-buffer-reuse"]
+
+
+# ---------------------------------------------------------- prng-key-reuse
+
+def test_prng_reuse_positive(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def sample(rng):
+            """Doc."""
+            a = jax.random.normal(rng, (2,))
+            b = jax.random.uniform(rng, (2,))
+            return a + b
+    ''', select=["prng-key-reuse"])
+    assert _rules_of(res) == ["prng-key-reuse"]
+
+
+def test_prng_reuse_in_loop_without_split(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def sample(rng, n):
+            """Doc."""
+            outs = []
+            for _ in range(n):
+                outs.append(jax.random.normal(rng, (2,)))
+            return outs
+    ''', select=["prng-key-reuse"])
+    assert _rules_of(res) == ["prng-key-reuse"]
+
+
+def test_prng_reuse_first_consumed_in_branch(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def sample(rng, cond):
+            """Consumed in one if-arm, consumed again after the if."""
+            a = 0
+            if cond:
+                a = jax.random.normal(rng, (2,))
+            b = jax.random.normal(rng, (2,))
+            return a + b
+    ''', select=["prng-key-reuse"])
+    assert _rules_of(res) == ["prng-key-reuse"]
+
+
+def test_prng_split_negative(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        def sample(rng, n):
+            """The repo idiom: split before every consumption."""
+            outs = []
+            for _ in range(n):
+                rng, sub = jax.random.split(rng)
+                outs.append(jax.random.normal(sub, (2,)))
+            a = jax.random.fold_in(rng, 7)
+            return outs, jax.random.normal(a, (2,))
+    ''', select=["prng-key-reuse"])
+    assert res.findings == []
+
+
+def test_prng_alias_import_and_noqa(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        from jax import random as jr
+
+        def sample(rng):
+            """Doc."""
+            a = jr.normal(rng, (2,))
+            b = jr.normal(rng, (2,))  # fleetx: noqa[prng-key-reuse] -- same draw wanted
+            c = jr.normal(rng, (2,))
+            return a + b + c
+    ''', select=["prng-key-reuse"])
+    # the noqa'd second draw is suppressed; the third still fires
+    assert len(res.findings) == 1 and len(res.suppressed) == 1
+
+
+# ------------------------------------------------------ pspec-mesh-mismatch
+
+def test_pspec_mismatch_positive_and_tuple(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P(("data", "fsdp"), "modle")
+    ''', select=["pspec-mesh-mismatch"])
+    assert _rules_of(res) == ["pspec-mesh-mismatch"]
+    assert "'modle'" in res.findings[0].message
+
+
+def test_pspec_valid_axes_negative(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        from jax.sharding import PartitionSpec
+
+        A = PartitionSpec("data", ("seq", "tensor"), None)
+        B = PartitionSpec()
+    ''', select=["pspec-mesh-mismatch"])
+    assert res.findings == []
+
+
+def test_pspec_repo_mesh_axes_are_parsed():
+    res = run_lint([os.path.join(REPO, "fleetx_tpu", "parallel")], root=REPO,
+                   select=["pspec-mesh-mismatch"])
+    assert res.findings == []
+
+
+# ----------------------------------------------------- traced-python-branch
+
+def test_traced_branch_positive(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+
+        @jax.jit
+        def step(x):
+            """Doc."""
+            if x > 0:
+                x = x * 2
+            while x < 10:
+                x = x + 1
+            return x
+    ''', select=["traced-python-branch"])
+    assert _rules_of(res) == ["traced-python-branch"] * 2
+
+
+def test_traced_branch_static_negative(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, accum):
+            """Branches on static args / shapes / closures are fine."""
+            if accum > 1:
+                x = x * accum
+            if x.shape[0] > 4:
+                x = x + 1
+            if x.dtype == "float32":
+                x = x * 2
+            return x
+    ''', select=["traced-python-branch"])
+    assert res.findings == []
+
+
+def test_traced_branch_taint_flows_through_assignment(tmp_path):
+    res = _lint_src(tmp_path, '''
+        """Doc."""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            """Doc."""
+            y = jnp.sum(x) + 1
+            if y > 0:  # tainted through the assignment
+                y = y * 2
+            return y
+    ''', select=["traced-python-branch"])
+    assert _rules_of(res) == ["traced-python-branch"]
+
+
+# ---------------------------------------------------------- dead-config-key
+
+def test_dead_config_key_positive(tmp_path):
+    (tmp_path / "conf.yaml").write_text(
+        "Engine:\n  max_steps: 10\n  warp_factor: 9\n")
+    (tmp_path / "eng.py").write_text(textwrap.dedent('''
+        """Doc."""
+
+        def build(cfg):
+            """Doc."""
+            eng = cfg.get("Engine") or {}
+            return int(eng.get("max_steps", 1))
+    '''))
+    res = run_lint([tmp_path / "conf.yaml", tmp_path / "eng.py"],
+                   root=tmp_path, select=["dead-config-key"])
+    assert [f.rule for f in res.findings] == ["dead-config-key"]
+    assert "warp_factor" in res.findings[0].message
+    # the YAML line number points at the key
+    assert res.findings[0].line == 3
+
+
+def test_dead_config_key_attribute_consumption_negative(tmp_path):
+    (tmp_path / "conf.yaml").write_text("Model:\n  hidden_size: 8\n")
+    (tmp_path / "mod.py").write_text(textwrap.dedent('''
+        """Doc."""
+
+        def build(cfg):
+            """AttrDict attribute access consumes the key."""
+            return cfg.Model.hidden_size * 2
+    '''))
+    res = run_lint([tmp_path / "conf.yaml", tmp_path / "mod.py"],
+                   root=tmp_path, select=["dead-config-key"])
+    assert res.findings == []
+
+
+def test_dead_config_key_inside_yaml_sequence(tmp_path):
+    (tmp_path / "conf.yaml").write_text(
+        "Data:\n  transform_ops:\n    - DecodeImage: {}\n    - BogusOp: {}\n")
+    (tmp_path / "m.py").write_text(textwrap.dedent('''
+        """Doc."""
+
+
+        def build(cfg):
+            """Doc."""
+            return cfg.get("Data", {}).get("transform_ops")
+
+
+        class DecodeImage:
+            """Registry-resolved transform."""
+
+            def run(self, x):
+                """Doc."""
+                y = x
+                return y
+    '''))
+    res = run_lint([tmp_path / "conf.yaml", tmp_path / "m.py"],
+                   root=tmp_path, select=["dead-config-key"])
+    msgs = [f.message for f in res.findings]
+    assert any("BogusOp" in m for m in msgs)
+    assert not any("DecodeImage" in m for m in msgs)
+
+
+def test_unprovided_section_reverse_direction(tmp_path):
+    (tmp_path / "conf.yaml").write_text("Engine:\n  max_steps: 10\n")
+    (tmp_path / "eng.py").write_text(textwrap.dedent('''
+        """Doc."""
+
+        def build(cfg):
+            """Reads a section no YAML provides."""
+            eng = cfg.get("Engine") or {}
+            gone = cfg.get("Enigne") or {}
+            return eng, gone
+    '''))
+    res = run_lint([tmp_path / "conf.yaml", tmp_path / "eng.py"],
+                   root=tmp_path, select=["dead-config-key"])
+    msgs = [f.message for f in res.findings]
+    assert any("Enigne" in m for m in msgs)
+    assert not any("'Engine'" in m for m in msgs)
+
+
+# ----------------------------------------------------------- docstring rules
+
+@pytest.mark.docstrings
+def test_docstring_rules_fire_and_skip(tmp_path):
+    res = _lint_src(tmp_path, '''
+        def visible(a, b):
+            x = a + b
+            return x + 1
+
+        def __init__(self):
+            y = 1
+            return y
+
+        def _private(a):
+            z = a * 2
+            return z + 1
+    ''', select=["docstrings"])
+    # module + `visible` missing; __init__/_private exempt
+    assert len(res.findings) == 2
+    assert all(f.rule == "docstring-missing" for f in res.findings)
+
+
+@pytest.mark.docstrings
+def test_docstring_wrapper_matches_driver():
+    wrapper = subprocess.run(
+        [sys.executable, os.path.join(REPO, "codestyle",
+                                      "check_docstrings.py")],
+        capture_output=True, text=True, cwd=REPO)
+    driver = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--select", "docstrings"],
+        capture_output=True, text=True, cwd=REPO)
+    assert wrapper.returncode == 0, wrapper.stdout + wrapper.stderr
+    assert driver.returncode == 0, driver.stdout + driver.stderr
+
+
+# ----------------------------------------------------- baseline + reporters
+
+def test_baseline_roundtrip(tmp_path):
+    src = '''
+        """Doc."""
+        import jax
+
+        @jax.jit
+        def step(x):
+            """Doc."""
+            return float(x)
+    '''
+    res = _lint_src(tmp_path, src, select=["host-sync-in-traced-code"])
+    assert len(res.findings) == 1
+    base = tmp_path / "baseline.json"
+    write_baseline(base, res.findings)
+    assert load_baseline(base) == {res.findings[0].fingerprint}
+    res2 = _lint_src(tmp_path, src, select=["host-sync-in-traced-code"],
+                     baseline_path=base)
+    assert res2.findings == [] and len(res2.baselined) == 1
+
+
+def test_render_json_schema(tmp_path):
+    res = _lint_src(tmp_path, HOST_SYNC_POS,
+                    select=["host-sync-in-traced-code"])
+    payload = render_json(res)
+    assert payload["schema_version"] == 1
+    assert payload["counts"]["findings"] == 1
+    f = payload["findings"][0]
+    for key in ("rule", "code", "path", "line", "col", "message",
+                "fingerprint"):
+        assert key in f, key
+    assert not payload["clean"]
+    assert "FX001" in render_text(res)
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    res = _lint_src(tmp_path, "def broken(:\n")
+    assert [f.rule for f in res.findings] == ["syntax-error"]
+
+
+def test_undecodable_file_is_a_finding_not_a_crash(tmp_path):
+    bad = tmp_path / "latin.py"
+    bad.write_bytes(b"# caf\xe9\nx = 1\n")
+    nul = tmp_path / "nul.py"
+    nul.write_bytes(b"x = 1\x00\n")
+    res = run_lint([bad, nul], root=tmp_path)
+    assert sorted(f.rule for f in res.findings) == ["syntax-error"] * 2
+
+
+def test_skip_unknown_rule_raises(tmp_path):
+    with pytest.raises(KeyError):
+        _lint_src(tmp_path, '"""Doc."""\n', skip=["no-such-rule"])
+
+
+def test_file_count_excludes_configs_unless_rule_scans_them(tmp_path):
+    (tmp_path / "conf.yaml").write_text("Engine:\n  max_steps: 1\n")
+    (tmp_path / "m.py").write_text('"""Doc."""\n')
+    paths = [tmp_path / "conf.yaml", tmp_path / "m.py"]
+    no_cfg = run_lint(paths, root=tmp_path, select=["docstrings"])
+    with_cfg = run_lint(paths, root=tmp_path, select=["dead-config-key"])
+    assert no_cfg.files == 1
+    assert with_cfg.files == 2
+
+
+def test_write_baseline_refuses_filtered_run(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         str(tmp_path), "--select", "docstrings", "--write-baseline"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "full-rule run" in proc.stderr
+
+
+# ---------------------------------------------------------- whole-repo gate
+
+def test_whole_repo_lint_is_clean():
+    """The CI contract: `python tools/lint.py` exits 0 on the tree."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--json", "-"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, f"lint found issues:\n{proc.stdout}"
+    # stdout carries the JSON payload then the text summary
+    payload = json.loads(proc.stdout[:proc.stdout.rindex("}") + 1])
+    assert payload["clean"] is True
+    assert len(payload["rules"]) >= 8
+
+
+def test_driver_json_and_exit_code_on_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('"""Doc."""\nimport jax\n\n\n@jax.jit\ndef f(x):\n'
+                   '    """Doc."""\n    return float(x)\n')
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad),
+         "--no-baseline", "--json", str(out)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    payload = json.loads(out.read_text())
+    assert payload["counts"]["findings"] == 1
+    assert payload["findings"][0]["code"] == "FX001"

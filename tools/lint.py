@@ -1,0 +1,106 @@
+"""fleetx-lint driver — run the static analysis suite over the tree.
+
+Usage::
+
+    python tools/lint.py                      # lint fleetx_tpu/ (all rules)
+    python tools/lint.py fleetx_tpu/core      # narrower scope
+    python tools/lint.py --select docstrings  # one category
+    python tools/lint.py --json report.json   # machine-readable output
+    python tools/lint.py --write-baseline     # accept the current backlog
+    python tools/lint.py --list-rules
+
+Exit codes follow ``tools/metrics_report.py``: 0 clean, 1 findings,
+2 usage/internal error.  The default baseline (``tools/lint_baseline.json``)
+is applied when present so legacy findings don't block CI; suppress single
+sites inline with ``# fleetx: noqa[rule-name] -- reason``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="JAX/TPU-aware static analysis for fleetx_tpu")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: fleetx_tpu/)")
+    ap.add_argument("--json", metavar="OUT",
+                    help="write the report as JSON (- for stdout)")
+    ap.add_argument("--select", action="append", default=[],
+                    help="rule name/code/category to run (repeatable or "
+                         "comma-separated)")
+    ap.add_argument("--skip", action="append", default=[],
+                    help="rule name/code/category to skip")
+    ap.add_argument("--baseline", metavar="PATH", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         "when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    from fleetx_tpu.lint import (all_rules, core, render_json, render_text,
+                                 run_lint)
+
+    if args.list_rules:
+        for rule in all_rules().values():
+            print(f"{rule.code}  {rule.name:<28} [{rule.category}] "
+                  f"{rule.description}")
+        return 0
+
+    paths = args.paths or [os.path.join(REPO_ROOT, "fleetx_tpu")]
+    select = [t.strip() for s in args.select for t in s.split(",") if t.strip()]
+    skip = [t.strip() for s in args.skip for t in s.split(",") if t.strip()]
+
+    if args.write_baseline and (select or skip):
+        # a filtered run would overwrite the baseline with a subset,
+        # silently dropping every unselected rule's accepted findings
+        print("error: --write-baseline requires a full-rule run "
+              "(drop --select/--skip)", file=sys.stderr)
+        return 2
+
+    baseline = args.baseline
+    if baseline is None and not args.no_baseline and \
+            os.path.exists(DEFAULT_BASELINE):
+        baseline = DEFAULT_BASELINE
+    if args.no_baseline or args.write_baseline:
+        baseline = None
+
+    try:
+        result = run_lint(paths, root=REPO_ROOT, select=select or None,
+                          skip=skip or None, baseline_path=baseline)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        out_path = args.baseline or DEFAULT_BASELINE
+        core.write_baseline(core.Path(out_path), result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to {out_path}")
+        return 0
+
+    if args.json:
+        payload = json.dumps(render_json(result), indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    print(render_text(result, verbose=args.verbose))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

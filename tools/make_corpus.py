@@ -80,6 +80,7 @@ def _read_text(path: str) -> str | None:
 
 
 def collect_documents(min_chars: int = 400) -> list[str]:
+    """Gather deduplicated documents of at least ``min_chars`` characters."""
     seen_hashes: set[bytes] = set()
     docs: list[str] = []
     paths: list[str] = []

@@ -42,6 +42,7 @@ def _stats(values):
 
 
 def summarize(records: list[dict]) -> dict:
+    """Aggregate step records into mean/min/max/last stats per metric."""
     steps = [r["step"] for r in records]
     wall = (records[-1]["ts"] - records[0]["ts"]) if len(records) > 1 else 0.0
     summary = {
@@ -69,6 +70,7 @@ _ROWS = (
 
 
 def print_table(summary: dict) -> None:
+    """Render the summary dict as an aligned text table."""
     print(f"records: {summary['records']}   "
           f"steps: {summary['first_step']} → {summary['last_step']}   "
           f"wall: {summary['wall_s']:.1f}s")

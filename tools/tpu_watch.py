@@ -53,6 +53,7 @@ def _now() -> str:
 
 
 def log(msg: str) -> None:
+    """Append a timestamped line to the watch log (and echo to stdout)."""
     os.makedirs(ART, exist_ok=True)
     line = f"[{_now()}] {msg}"
     with open(LOG, "a") as f:
@@ -363,6 +364,7 @@ def _git(args: list[str]) -> subprocess.CompletedProcess:
 
 
 def commit_artifacts(state: dict) -> None:
+    """Write the collected bench results into BENCH_SELF.json."""
     bench_self = os.path.join(_REPO, "BENCH_SELF.json")
     payload = {
         "written_at": _now(),
