@@ -154,6 +154,11 @@ def _bench_impl() -> dict:
         model_kwargs["vocab_chunk"] = VOCAB_CHUNK
     if os.environ.get("FLEETX_BENCH_SCAN_UNROLL"):
         model_kwargs["scan_unroll"] = int(os.environ["FLEETX_BENCH_SCAN_UNROLL"])
+    # bf16 remat residuals (docs/bandwidth_levers.md): halves the backward's
+    # scan-stacked residual DUS bytes when the saved values are wider
+    remat_save_dtype = os.environ.get("FLEETX_BENCH_REMAT_SAVE_DTYPE")
+    if remat_save_dtype:
+        model_kwargs["remat_save_dtype"] = remat_save_dtype
     cfg = {
         "Model": dict(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=layers,
                       num_attention_heads=16, ffn_hidden_size=4096,
@@ -163,6 +168,11 @@ def _bench_impl() -> dict:
         # hardware-accelerated PRNG for dropout masks (measured ~8% step-time
         # saving vs threefry on v5e; same statistics, different stream)
         "Global": {"seed": 0, "prng_impl": "rbg"},
+        # telemetry for the input-pipeline phase below: span histograms +
+        # the data-stall integral, no Chrome trace (FLEETX_BENCH_TRACE
+        # already covers the XLA-level capture)
+        "Observability": {"enable": True, "trace": {"enable": False},
+                          "output_dir": "./output/bench_telemetry"},
     }
     module = GPTModule(cfg)
     lr = build_lr_scheduler({"max_lr": 3e-4, "warmup_steps": 100,
@@ -202,6 +212,34 @@ def _bench_impl() -> dict:
             jax.profiler.stop_trace()
 
     tokens_per_s = bsz * seq / dt
+
+    # ---- input-pipeline phase (docs/bandwidth_levers.md): drive the SAME
+    # compiled step through engine.fit so the data path (host fetch +
+    # per-leaf device_put sharding) is measured too, with the device-side
+    # prefetch iterator gated by FLEETX_BENCH_PREFETCH (queue depth; 0 =
+    # the serial fetch→shard→step loop). data_stall_frac and the span
+    # means land in the JSON so the double-buffering A/B is auditable
+    # from the bench output alone.
+    prefetch_depth = int(os.environ.get("FLEETX_BENCH_PREFETCH", "2"))
+    stall_frac, fit_wall, fit_error = 0.0, 0.0, None
+    span_means_ms = {}
+    try:
+        engine.prefetch_to_device = prefetch_depth
+        engine.logging_freq = n_steps
+        host_batches = [dict(batch) for _ in range(n_steps)]
+        stall0 = engine.obs.stall_seconds_total()
+        t0 = time.perf_counter()
+        engine.fit(iter(host_batches))
+        fit_wall = time.perf_counter() - t0
+        stall_frac = ((engine.obs.stall_seconds_total() - stall0)
+                      / max(fit_wall, 1e-9))
+        for phase in ("data_fetch", "shard_batch", "shard_batch_async"):
+            summ = engine.obs.registry.histogram(phase).summary()
+            if summ.get("count"):
+                span_means_ms[phase] = round(summ["mean"] * 1000.0, 3)
+    except Exception as e:  # the phase must never cost the measured number
+        fit_error = f"{type(e).__name__}: {e}"[:200]
+
     name = "gpt345m" if not scaled else f"gpt{layers}l_scaled"
     variant = not scaled and (bsz != DEFAULT_BATCH or seq != DEFAULT_SEQ
                               or bool(VOCAB_CHUNK))
@@ -220,7 +258,19 @@ def _bench_impl() -> dict:
         "loss": round(loss, 3),
         "flash": flash_status,
         "device_kind": getattr(dev, "device_kind", platform),
+        # input-pipeline evidence: fraction of the fit phase's wall time the
+        # consumer loop was host-blocked on data (fetch + on-path sharding),
+        # plus per-phase span means; with prefetch on, shard_batch_async
+        # replaces shard_batch and the stall integral excludes it
+        "data_stall_frac": round(stall_frac, 4),
+        "span_means_ms": span_means_ms,
+        "prefetch_depth": prefetch_depth,
+        "fit_step_time_s": round(fit_wall / n_steps, 4),
     }
+    if fit_error:
+        result["fit_error"] = fit_error
+    if remat_save_dtype:
+        result["remat_save_dtype"] = remat_save_dtype
     from fleetx_tpu.utils.hardware import gpt_flops_per_token, peak_flops
 
     peak = peak_flops(dev)

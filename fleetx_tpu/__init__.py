@@ -11,6 +11,11 @@ Parallelism is expressed TPU-first: one named ``jax.sharding.Mesh`` over
 ICI/DCN carrying ``(pipe, data, fsdp, seq, tensor)`` axes, pjit/GSPMD for
 collective insertion, ``shard_map`` where an explicit schedule matters (1F1B
 pipeline, ring attention), and Pallas kernels for flash attention.
+
+The package root stays import-light (no jax) so AST-only consumers like
+``tools/lint.py`` load instantly; JAX-global configuration (e.g. the
+sharding-invariant partitionable threefry) lives in ``parallel/mesh.py``,
+which every sharded execution path imports.
 """
 
 __version__ = "0.1.0"

@@ -113,6 +113,9 @@ class EagerEngine(BasicEngine):
         self.eval_freq = _int(eng, "eval_freq", 0)
         self.eval_iters = _int(eng, "eval_iters", 10)
         self.accumulate_steps = max(_int(eng, "accumulate_steps", 1), 1)
+        # device-side input double buffering (docs/bandwidth_levers.md):
+        # depth of the prefetch-to-device queue; 0 = serial fetch→shard→step
+        self.prefetch_to_device = _int(eng, "prefetch_to_device", 0)
         # "step" (GPT pretrain): loop the loader until max_steps; "epoch"
         # (ViT-style): stop after epoch_num passes (reference run_mode,
         # eager_engine.py:250-330)
@@ -415,24 +418,33 @@ class EagerEngine(BasicEngine):
         # checkpoint resumed at (meta "epoch"); each loader re-iteration
         # advances it. In "epoch" run_mode, epoch_num bounds the run; in
         # "step" mode (GPT pretrain) the loader loops until max_steps.
+        # The generator yields (epoch, batch) and the CONSUMER below owns
+        # self._epoch: with the device prefetcher the generator runs up to
+        # `depth` batches ahead on the producer thread, and a mid-window
+        # save() must not persist an epoch the training loop has not
+        # reached. `final_epoch` carries a cleanly-exhausted generator's
+        # boundary value (the "run finished N epochs" checkpoint meta).
         self._epoch = self._start_epoch
+        final_epoch = [self._start_epoch]
 
         def batches():
-            yield first
+            epoch = self._start_epoch
+            yield epoch, first
             for b in it:
-                yield self.module.pretreating_batch(b)
+                yield epoch, self.module.pretreating_batch(b)
             while True:  # re-iterate epochs over the same loader
-                self._epoch += 1
-                if self.run_mode == "epoch" and self._epoch >= epoch_num:
+                epoch += 1
+                final_epoch[0] = epoch
+                if self.run_mode == "epoch" and epoch >= epoch_num:
                     return
                 got = False
                 for b in train_data_loader:
                     got = True
-                    yield self.module.pretreating_batch(b)
+                    yield epoch, self.module.pretreating_batch(b)
                 if not got:  # one-shot iterator exhausted — stop cleanly
                     return
 
-        with self._ctx():
+        with self._ctx(), contextlib.ExitStack() as cleanup:
             t_last = time.time()
             window = 0
             losses = []
@@ -440,15 +452,39 @@ class EagerEngine(BasicEngine):
             last_eval = last_save = -1  # fp16 resync can re-visit a step
             self.profiler.arm()  # each fit gets its own trace window
             batch_iter = iter(batches())
+            prefetcher = None
+            if self.prefetch_to_device > 0:
+                # device-side double buffering: a producer thread shards
+                # batch N+1 while step N is in flight, so the blocking
+                # per-leaf device_put leaves the step critical path; the
+                # consumer-side wait below is pure input starvation. The
+                # cleanup callback releases the producer thread on EVERY
+                # exit (max_steps, exhausted loader, or a raising step).
+                from fleetx_tpu.data.prefetch import DevicePrefetcher
+
+                prefetcher = DevicePrefetcher(
+                    batch_iter,
+                    lambda eb: (eb[0], self.shard_batch(eb[1])),
+                    depth=self.prefetch_to_device, obs=self.obs)
+                cleanup.callback(prefetcher.close)
             metrics: dict = {}
             while step < self.max_steps:
-                with self.obs.timed_span("data_fetch"):
-                    batch = next(batch_iter, None)
-                if batch is None:
+                if prefetcher is not None:
+                    with self.obs.timed_span("data_fetch"):
+                        item = next(prefetcher, None)
+                else:
+                    with self.obs.timed_span("data_fetch"):
+                        item = next(batch_iter, None)
+                if item is None:
+                    self._epoch = final_epoch[0]
                     break
+                self._epoch, payload = item
                 self.profiler.maybe_start(step)
-                with self.obs.timed_span("shard_batch"):
-                    sharded = self.shard_batch(batch)
+                if prefetcher is not None:
+                    sharded = payload  # already on-device (producer thread)
+                else:
+                    with self.obs.timed_span("shard_batch"):
+                        sharded = self.shard_batch(payload)
                 # the span covers dispatch, not device runtime (the step is
                 # async); device time shows up in the XLA trace the
                 # TraceAnnotation nests under

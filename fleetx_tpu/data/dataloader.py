@@ -15,6 +15,50 @@ from typing import Callable, Iterable, Optional
 import numpy as np
 
 
+class StopAwareQueue:
+    """Bounded producer→consumer hand-off whose blocking ``put`` polls a
+    consumer-owned stop flag.
+
+    The shutdown contract shared by ``DataLoader.__iter__`` and
+    ``prefetch.DevicePrefetcher``: a producer thread must never outlive a
+    consumer that walked away mid-epoch, so ``put`` gives up within one
+    poll interval of ``stop()`` instead of blocking on a full queue
+    forever.
+    """
+
+    _POLL_S = 0.1
+
+    def __init__(self, maxsize: int):
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(int(maxsize), 1))
+        self._stop = threading.Event()
+
+    def put(self, item) -> bool:
+        """Producer-side put; False once the consumer has stopped."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=self._POLL_S)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def get(self):
+        """Consumer-side blocking get."""
+        return self._q.get()
+
+    def stop(self) -> None:
+        """Consumer signals abandonment; pending puts unblock promptly."""
+        self._stop.set()
+
+    def drain(self) -> None:
+        """Discard queued items (lets a producer blocked in put() exit)."""
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue_mod.Empty:
+            pass
+
+
 def default_collate(samples: list) -> dict:
     """Stack dict-of-array samples into a batch (reference ``Stack`` collate,
     ``data/sampler/collate.py:27``)."""
@@ -48,23 +92,35 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._make(indices)
             return
-        q: queue_mod.Queue = queue_mod.Queue(maxsize=self.prefetch)
+        q = StopAwareQueue(self.prefetch)
         sentinel = object()
+        error: list[BaseException] = []
 
         def producer():
             try:
                 for indices in self.batch_sampler:
-                    q.put(self._make(indices))
-            finally:
-                q.put(sentinel)
+                    if not q.put(self._make(indices)):
+                        return  # consumer abandoned the iterator
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                # a raising _make used to hit a bare `finally: put(sentinel)`
+                # and the epoch ended CLEANLY with the error swallowed;
+                # carry it to the consumer instead
+                error.append(e)
+            q.put(sentinel)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="fleetx-dataloader")
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if error:
+                        raise error[0]
+                    break
+                yield item
+        finally:
+            q.stop()
 
     def __len__(self) -> int:
         return len(self.batch_sampler)

@@ -1,0 +1,98 @@
+"""Device-side input double buffering (docs/bandwidth_levers.md).
+
+``EagerEngine.fit`` historically ran ``next(batch_iter)`` → ``shard_batch``
+(a blocking per-leaf ``jax.device_put``) → ``train_step`` serially, so the
+host-to-device copy of batch N sat on the step-N critical path.
+``DevicePrefetcher`` moves it off: a background thread pulls host batches
+and shards them onto the mesh a depth-bounded queue ahead, so the transfer
+for batch N+1 overlaps the device executing step N. The consumer's wait in
+``__next__`` is then pure input starvation — which is exactly what the
+``data_stall`` derived metric should integrate — while the producer's
+``device_put`` time is recorded under the separate ``shard_batch_async``
+span so it never counts as consumer-blocked time.
+
+The shutdown contract (stop-aware bounded puts, producer exceptions
+re-raised consumer-side) is ``dataloader.StopAwareQueue`` — one
+implementation shared with ``DataLoader.__iter__``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+from fleetx_tpu.data.dataloader import StopAwareQueue
+
+__all__ = ["DevicePrefetcher"]
+
+
+class _ProducerError:
+    """Marker carrying a producer-side exception to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetcher:
+    """Iterator of device-sharded batches, produced ``depth`` ahead.
+
+    ``shard_fn`` (typically ``EagerEngine.shard_batch``) runs on the
+    producer thread — ``jax.device_put`` is thread-safe and the transfers
+    it enqueues proceed while the main thread dispatches train steps.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, host_iter: Iterator, shard_fn: Callable[[Any], Any],
+                 depth: int = 2, obs: Optional[Any] = None):
+        self._queue = StopAwareQueue(depth)
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._produce, args=(host_iter, shard_fn, obs),
+            daemon=True, name="fleetx-device-prefetch")
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+    def _produce(self, host_iter: Iterator, shard_fn: Callable,
+                 obs: Optional[Any]) -> None:
+        try:
+            for batch in host_iter:
+                # span name deliberately differs from the engine's
+                # "shard_batch": this copy overlaps device compute, so it
+                # must not feed the data-stall integral
+                # (Observability.stall_seconds_total)
+                if obs is not None and getattr(obs, "enabled", False):
+                    with obs.timed_span("shard_batch_async"):
+                        sharded = shard_fn(batch)
+                else:
+                    sharded = shard_fn(batch)
+                if not self._queue.put(sharded):
+                    return  # consumer closed the prefetcher
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            self._queue.put(_ProducerError(e))
+            return
+        self._queue.put(self._SENTINEL)
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:
+            raise StopIteration
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _ProducerError):
+            self._done = True
+            raise item.exc
+        return item
+
+    def close(self) -> None:
+        """Release the producer thread (idempotent; safe mid-iteration)."""
+        self._queue.stop()
+        self._queue.drain()  # unblock a producer waiting in put()
+        self._thread.join(timeout=5.0)

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from flax import struct
+from jax.ad_checkpoint import checkpoint_name
 
 _NEG_INF_F32 = -1e30  # finite stand-in for -inf (keeps exp/grad NaN-free)
 
@@ -62,6 +63,16 @@ class GPTConfig:
     recompute_granularity: str = "full"
     scan_layers: bool = True
     scan_unroll: int = 1  # layers per scan-body unroll (perf lever)
+    # dtype for remat-saved residuals (docs/bandwidth_levers.md): when set
+    # (e.g. bfloat16), the remat-saveable matmul outputs are routed through
+    # a named cast and the "dots" policy saves the CAST values instead of
+    # the originals — halving the scan-stacked dynamic-update-slice bytes
+    # the backward pays per layer; the backward upcasts on use. None keeps
+    # residuals at the compute dtype. Effective only with use_recompute +
+    # "dots" granularity on dense (non-MoE) stacks — elsewhere the casts
+    # stay inert instead of quantising the forward for no saving
+    # (_residual_casts_active).
+    remat_save_dtype: Any = None
     use_flash_attention: bool = True
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
     sequence_parallel: bool = False
@@ -110,9 +121,52 @@ def _flash_residuals_saveable(prim, *_, **__) -> bool:
     return getattr(prim, "name", "") == "pallas_call"
 
 
+#: the remat-saveable intermediates routed through the ``remat_save_dtype``
+#: cast — one name per matmul output the stock dots policy would save; the
+#: ``save_only_these_names`` policy keys on exactly this set
+RESIDUAL_NAMES = ("res_qkv", "res_attn_out", "res_mlp_wi", "res_mlp_wo")
+
+
+def _residual_casts_active(cfg: GPTConfig) -> bool:
+    """True when the named residual casts actually buy saved bytes: the
+    "dots" policy is the only consumer of the names, so outside
+    use_recompute+dots the cast would quantise the forward for zero
+    benefit; MoE stacks don't carry the names (MoEMlp's expert matmuls
+    would silently lose their saveability under a names-only policy), so
+    the diet stays off there too."""
+    return (cfg.remat_save_dtype is not None and cfg.use_recompute
+            and cfg.recompute_granularity == "dots"
+            and cfg.moe_num_experts == 0)
+
+
+def _save_residual(x: jax.Array, name: str, cfg: GPTConfig) -> jax.Array:
+    """Route a remat-saveable intermediate through a named dtype cast.
+
+    When the casts are active (``_residual_casts_active``), the value is
+    cast down, tagged with ``checkpoint_name`` (so
+    ``save_only_these_names`` saves the CAST copy), and cast back for the
+    ongoing forward compute — the backward replays only the upcast from
+    the saved low-precision residual. The round-trip deliberately
+    quantises the forward too: saved-vs-recomputed values must agree or
+    the gradients would be inconsistent across the remat boundary.
+    """
+    if not _residual_casts_active(cfg):
+        return x
+    orig = x.dtype
+    return checkpoint_name(
+        x.astype(cfg.remat_save_dtype), name).astype(orig)
+
+
 def _dots_policy(cfg: GPTConfig):
-    """The "dots" remat policy: matmul outputs + flash residuals."""
-    dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    """The "dots" remat policy: matmul outputs + flash residuals.
+
+    With the residual casts active, the matmul outputs are saved through
+    their named casts (``_save_residual``) INSTEAD of the raw dot outputs —
+    same remat structure, half the stacked-residual bytes at bf16."""
+    if _residual_casts_active(cfg):
+        dots = jax.checkpoint_policies.save_only_these_names(*RESIDUAL_NAMES)
+    else:
+        dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     if not cfg.use_flash_attention:
         return dots
     return jax.checkpoint_policies.save_from_both_policies(
@@ -189,6 +243,8 @@ class MultiHeadAttention(nn.Module):
             qkv_k = fake_quant(qkv_k, cfg.qat_bits, axis=0)
         qkv = jnp.einsum("bsh,hcnd->bcsnd", x, qkv_k)
         qkv = qkv + qkv_bias.astype(cfg.dtype)[:, None, :, :]
+        if layer_cache is None:  # decode has no backward — skip the cast
+            qkv = _save_residual(qkv, "res_qkv", cfg)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, s, n, d]
         q = with_logical(q, ("batch", "act_seq", "act_heads", "act_kv"))
 
@@ -224,6 +280,8 @@ class MultiHeadAttention(nn.Module):
             out_k = fake_quant(out_k, cfg.qat_bits, axis=(0, 1))
         out = jnp.einsum("bsnd,ndh->bsh", attn_out, out_k)
         out = out + out_bias.astype(cfg.dtype)
+        if layer_cache is None:
+            out = _save_residual(out, "res_attn_out", cfg)
         return out, new_cache
 
     def _core_attn(self, q, k, v, deterministic: bool) -> jax.Array:
@@ -308,7 +366,7 @@ class GPTMlp(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, save_residuals: bool = True) -> jax.Array:
         cfg = self.cfg
         wi = self.param("wi_kernel", param_with_axes(_dense_init(cfg), ("embed", "mlp")),
                         (cfg.hidden_size, cfg.ffn_dim), cfg.param_dtype)
@@ -327,13 +385,16 @@ class GPTMlp(nn.Module):
             wi_k = fake_quant(wi_k, cfg.qat_bits, axis=0)
             wo_k = fake_quant(wo_k, cfg.qat_bits, axis=0)
         y = jnp.einsum("bsh,hm->bsm", x, wi_k) + bi.astype(cfg.dtype)
+        if save_residuals:
+            y = _save_residual(y, "res_mlp_wi", cfg)
         y = with_logical(y, ("batch", "act_seq", "mlp"))
         y = nn.gelu(y, approximate=True)
         if cfg.use_qat:
             from fleetx_tpu.ops.quantization import fake_quant
 
             y = fake_quant(y, cfg.qat_act_bits)
-        return jnp.einsum("bsm,mh->bsh", y, wo_k) + bo.astype(cfg.dtype)
+        out = jnp.einsum("bsm,mh->bsh", y, wo_k) + bo.astype(cfg.dtype)
+        return _save_residual(out, "res_mlp_wo", cfg) if save_residuals else out
 
 
 class LayerNorm(nn.Module):
@@ -404,7 +465,9 @@ class TransformerDecoderLayer(nn.Module):
                     jnp.float32)
             y = MoEMlp(cfg, name="mlp")(y, aux_gate=aux_gate)
         else:
-            y = GPTMlp(cfg, name="mlp")(y)
+            # decode (layer_cache set) has no backward — skip the residual
+            # casts there, mirroring the attention-side gating above
+            y = GPTMlp(cfg, name="mlp")(y, save_residuals=layer_cache is None)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=False)
         x = residual + y
@@ -705,7 +768,7 @@ def config_from_dict(d: dict) -> GPTConfig:
     known = {f.name for f in dataclasses.fields(GPTConfig)}
     kwargs = {k: v for k, v in d.items() if k in known and v is not None}
     dtype_map = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}
-    for key in ("dtype", "param_dtype"):
+    for key in ("dtype", "param_dtype", "remat_save_dtype"):
         if isinstance(kwargs.get(key), str):
             kwargs[key] = dtype_map[kwargs[key]]
     return GPTConfig(**kwargs)

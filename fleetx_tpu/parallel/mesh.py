@@ -21,6 +21,7 @@ The HCG "get_*_group/rank" API surface maps to mesh-axis lookups on
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import numpy as np
@@ -28,6 +29,21 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 from fleetx_tpu.utils.log import logger
+
+# Sharding-invariant PRNG: with the legacy (non-partitionable) threefry,
+# GSPMD may partition the random-bits computation of a *sharded* jit output
+# so each device hashes a different counter range — the same PRNGKey then
+# yields DIFFERENT parameter initialisations (and dropout masks) on a
+# 1-device vs an 8-device mesh, breaking the dp/tp/fsdp loss-parity
+# guarantee tests/test_engine.py asserts. The partitionable implementation
+# makes every draw a pure function of (key, position) regardless of layout;
+# it is also the upstream default going forward. Set here (every sharded
+# path imports the mesh substrate) rather than in the package root, which
+# stays importable without jax initialisation (tools/lint.py is AST-only).
+# An explicit JAX_THREEFRY_PARTITIONABLE env setting wins (e.g. to
+# reproduce an old run's exact init stream).
+if "JAX_THREEFRY_PARTITIONABLE" not in os.environ:
+    jax.config.update("jax_threefry_partitionable", True)
 
 MESH_AXES = ("pipe", "data", "fsdp", "seq", "tensor")
 

@@ -218,6 +218,9 @@ def process_engine_config(config: AttrDict) -> AttrDict:
     eng.setdefault("logging_freq", 10)
     eng.setdefault("eval_freq", None)
     eng.setdefault("eval_iters", 10)
+    # device-side input double buffering (docs/bandwidth_levers.md): depth of
+    # the prefetch-to-device queue; 0 keeps the serial fetch→shard→step loop
+    eng.setdefault("prefetch_to_device", 0)
     mp = eng.setdefault("mix_precision", AttrDict())
     mp.setdefault("enable", True)
     mp.setdefault("dtype", "bfloat16")

@@ -175,3 +175,72 @@ def test_build_dataloader_end_to_end(corpus_prefix):
     # fresh loader: 24 samples / (4 x 2 replicas) = 3 global batches
     fresh = build_dataloader(cfg, "Train", num_replicas=2, rank=1)
     assert len(list(iter(fresh))) == 3
+
+
+# ------------------------------------------- loader producer semantics
+
+
+class _BoomDataset:
+    """Dataset raising at a chosen index (producer-thread failure)."""
+
+    def __init__(self, boom_at=3):
+        self.boom_at = boom_at
+
+    def __getitem__(self, i):
+        if i == self.boom_at:
+            raise ValueError(f"corrupt sample {i}")
+        return {"x": np.full((2,), i, np.int32)}
+
+    def __len__(self):
+        return 8
+
+
+def _loader_threads():
+    import threading
+
+    return [t for t in threading.enumerate()
+            if t.name == "fleetx-dataloader" and t.is_alive()]
+
+
+def test_dataloader_reraises_producer_exception():
+    """A raising dataset/collate must surface in the consumer, not end the
+    epoch cleanly (the old `finally: put(sentinel)` swallowed it)."""
+    dl = DataLoader(_BoomDataset(boom_at=3), [[0], [1], [2], [3], [4]],
+                    prefetch=2)
+    got = []
+    with pytest.raises(ValueError, match="corrupt sample 3"):
+        for batch in dl:
+            got.append(int(batch["x"][0, 0]))
+    assert got == [0, 1, 2]  # everything before the fault was delivered
+
+
+def test_dataloader_zero_prefetch_propagates_too():
+    dl = DataLoader(_BoomDataset(boom_at=0), [[0]], prefetch=0)
+    with pytest.raises(ValueError, match="corrupt sample 0"):
+        next(iter(dl))
+
+
+def test_dataloader_producer_exits_on_early_abandon():
+    """Breaking out of the iterator mid-epoch must release the producer
+    thread promptly (it used to block forever on a full queue)."""
+    import time as _time
+
+    dl = DataLoader(_BoomDataset(boom_at=10**9),
+                    [[i % 8] for i in range(64)], prefetch=1)
+    it = iter(dl)
+    next(it)
+    assert _loader_threads()  # producer alive, blocked on the full queue
+    it.close()  # consumer walks away
+    deadline = _time.monotonic() + 5.0
+    while _loader_threads() and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    assert not _loader_threads(), "producer thread leaked after abandon"
+
+
+def test_dataloader_full_epoch_unchanged():
+    """The stop-aware puts keep the happy path byte-identical."""
+    ds = _BoomDataset(boom_at=10**9)
+    batches = [[i % 8] for i in range(6)]
+    serial = [b["x"].tolist() for b in DataLoader(ds, batches, prefetch=0)]
+    threaded = [b["x"].tolist() for b in DataLoader(ds, batches, prefetch=3)]
+    assert serial == threaded

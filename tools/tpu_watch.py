@@ -344,6 +344,19 @@ def _capture_gpt_unroll(state: dict) -> None:
                   for u in ("2", "4")])
 
 
+def _capture_gpt_bf16res(state: dict) -> None:
+    """bf16 remat residuals A/B (docs/bandwidth_levers.md): same config as
+    gpt_policyfix with Model.remat_save_dtype=bfloat16 — the "dots" policy
+    saves named bf16 casts of the matmul outputs instead of the originals.
+    At the bench's bf16 compute dtype the saved dots are already 2 bytes,
+    so the expected on-chip delta is ~neutral; the capture verifies that
+    claim (and any win from the policy's tighter saveable set) with the
+    usual audit trail. Read against gpt_policyfix."""
+    _bench_sweep(state, "gpt_bf16res",
+                 [("", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                        "FLEETX_BENCH_REMAT_SAVE_DTYPE": "bfloat16"}, {})])
+
+
 CAPTURES = [
     ("gpt", _capture_gpt),
     ("gpt_trace", _capture_gpt_trace),
@@ -354,6 +367,7 @@ CAPTURES = [
     ("losscurve", _capture_losscurve),
     ("gpt_policyfix", _capture_gpt_policyfix),
     ("gpt_unroll", _capture_gpt_unroll),
+    ("gpt_bf16res", _capture_gpt_bf16res),
     ("imagen", _capture_imagen),
 ]
 
