@@ -173,6 +173,14 @@ def _bench_impl() -> dict:
         # already covers the XLA-level capture)
         "Observability": {"enable": True, "trace": {"enable": False},
                           "output_dir": "./output/bench_telemetry"},
+        # resilience runtime ON for the fit phase so guard/watchdog overhead
+        # is auditable from the bench JSON (docs/resilience.md). The in-step
+        # skip is disabled so the HEADLINE number measures the unmodified
+        # train step; guard + watchdog are host-side only.
+        "Resilience": {"enable": True, "auto_resume": False,
+                       "guard": {"skip_nonfinite_update": False},
+                       "watchdog": {"enable": True, "min_timeout_s": 300.0,
+                                    "action": "log"}},
     }
     module = GPTModule(cfg)
     lr = build_lr_scheduler({"max_lr": 3e-4, "warmup_steps": 100,
@@ -266,6 +274,15 @@ def _bench_impl() -> dict:
         "span_means_ms": span_means_ms,
         "prefetch_depth": prefetch_depth,
         "fit_step_time_s": round(fit_wall / n_steps, 4),
+        # resilience counters (docs/resilience.md): all-zero on a healthy
+        # run; fit_step_time_s vs step_time_s bounds the guard/watchdog
+        # overhead since both run the same compiled step
+        "resilience": {
+            k: int(engine.obs.registry.counter(k).value)
+            for k in ("nonfinite_skips", "nonfinite_windows",
+                      "rollbacks_total", "ckpt_retries_total",
+                      "preemption_exits", "watchdog_stalls",
+                      "ckpt_gc_total")},
     }
     if fit_error:
         result["fit_error"] = fit_error

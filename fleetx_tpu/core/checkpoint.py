@@ -25,6 +25,8 @@ import jax
 
 from fleetx_tpu.observability.metrics import get_registry
 from fleetx_tpu.observability.trace import span
+from fleetx_tpu.resilience import faults as faults_mod
+from fleetx_tpu.resilience.policy import call_with_retry
 from fleetx_tpu.utils.log import logger
 
 try:
@@ -80,21 +82,42 @@ def save_checkpoint(directory: str, step: int, state: Any,
     """
     finalize_async_saves()  # at most one outstanding async save
     path = os.path.abspath(_step_dir(directory, step))
-    if os.path.isdir(path) and not os.path.exists(os.path.join(path, _META_NAME)):
+    if jax.process_index() == 0 and os.path.isdir(path) and \
+            _read_meta(path) is None:
+        # covers both the missing-meta (crash between state and meta
+        # writes) and corrupt-meta (crash mid-json.dump before the write
+        # became atomic) shapes of a half-written save; rank-0 gated like
+        # _write_meta/gc_checkpoints — N hosts racing rmtree on shared
+        # storage crash each other with ENOENT/ENOTEMPTY
         logger.info("removing half-written checkpoint: %s", path)
         shutil.rmtree(path)
     ckptr = _get_checkpointer()
     reg = get_registry()
     t0 = time.perf_counter()
-    with span("checkpoint_write", step=int(step)):
+    retries = reg.counter("ckpt_retries_total")
+
+    def _write_state():
+        # injection point first so an injected transient failure exercises
+        # the same retry path a real I/O blip would
+        faults_mod.fire("ckpt_write")
         ckptr.save(os.path.join(path, "state"), state, force=True)
+        if not async_save:
+            # orbax commits in the background even for "sync" callers: the
+            # real disk error surfaces HERE, so the drain must live inside
+            # the retried fn — a failure re-dispatches the whole save
+            # (force=True overwrites the partial attempt)
+            ckptr.wait_until_finished()
+
+    with span("checkpoint_write", step=int(step)):
+        call_with_retry(_write_state, desc="checkpoint state write",
+                        counter=retries)
         full_meta = dict(meta or {}, step=int(step))
         if async_save:
             _pending.append((path, full_meta))
             logger.info("async checkpoint started: %s", path)
         else:
-            ckptr.wait_until_finished()
-            _write_meta(path, full_meta)
+            call_with_retry(lambda: _write_meta(path, full_meta),
+                            desc="checkpoint meta write", counter=retries)
             logger.info("saved checkpoint: %s", path)
     # duration/bytes telemetry: async saves report the (short) snapshot
     # window here; the drain shows up under ckpt_finalize
@@ -107,48 +130,180 @@ def save_checkpoint(directory: str, step: int, state: Any,
 
 
 def _write_meta(path: str, meta: dict) -> None:
+    """Atomically publish the completion marker: temp file + ``os.replace``.
+
+    The meta file is what ``latest_step`` counts as "this checkpoint is
+    complete", so it must appear all-or-nothing — a crash mid-``json.dump``
+    into the final name would leave a truncated marker that a resume
+    counts as a complete checkpoint and then dies parsing.
+    """
     if jax.process_index() == 0:
-        with open(os.path.join(path, _META_NAME), "w") as f:
-            json.dump(meta, f)
+        target = os.path.join(path, _META_NAME)
+        tmp = f"{target}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, target)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+
+
+def _read_meta(path: str) -> Optional[dict]:
+    """The step dir's meta dict, or None when absent/corrupt (with a
+    warning for the corrupt case — it means a pre-atomic-write crash or
+    storage damage, and the dir must not count as a complete checkpoint).
+
+    Transient READ failures are retried under the process retry policy and
+    only classified as "incomplete" once exhausted: an I/O blip on an
+    intact meta must not make the resume path skip (or ``save_checkpoint``
+    delete) a perfectly good checkpoint.
+    """
+    target = os.path.join(path, _META_NAME)
+    if not os.path.exists(target):
+        return None
+
+    def _load() -> str:
+        with open(target) as f:
+            return f.read()
+
+    try:
+        raw = call_with_retry(_load, desc="checkpoint meta read")
+    except OSError as e:
+        logger.warning("unreadable checkpoint meta %s (%s) — treating %s "
+                       "as incomplete", target, e, path)
+        return None
+    try:
+        meta = json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+        logger.warning("corrupt checkpoint meta %s (%s) — treating %s as "
+                       "incomplete", target, e, path)
+        return None
+    if not isinstance(meta, dict):
+        logger.warning("checkpoint meta %s is not a dict — treating %s as "
+                       "incomplete", target, path)
+        return None
+    return meta
 
 
 def finalize_async_saves() -> None:
-    """Block until outstanding async saves are durable and mark them complete."""
+    """Block until outstanding async saves are durable and mark them complete.
+
+    A sticky background-commit failure (orbax re-raises the stored error
+    from ``wait_until_finished``; the device snapshot is gone, so the save
+    cannot be re-dispatched) ABANDONS the pending save instead of killing
+    training: the training state is intact, the half-written dir is
+    removed immediately (periodic saves never revisit that step, so
+    nothing else would reclaim the partial payload), and the loss is
+    recorded loudly (``ckpt_failed_total`` + an error log) so a persistent
+    storage problem is visible, not masked.
+    """
     if not _pending:
         return
-    with span("ckpt_finalize"), get_registry().timer("ckpt_finalize"):
-        _get_checkpointer().wait_until_finished()
+    reg = get_registry()
+    retries = reg.counter("ckpt_retries_total")
+    with span("ckpt_finalize"), reg.timer("ckpt_finalize"):
+        try:
+            _get_checkpointer().wait_until_finished()
+        except Exception as e:  # noqa: BLE001 — abandoning, not crashing
+            abandoned = [p for p, _ in _pending]
+            _pending.clear()
+            reg.counter("ckpt_failed_total").inc(len(abandoned))
+            logger.error(
+                "async checkpoint commit FAILED (%s: %s) — abandoning %s; "
+                "training continues, the next periodic save retries from "
+                "scratch", type(e).__name__, e, abandoned)
+            # remove the half-written dirs NOW: periodic saves advance
+            # monotonically and never revisit these steps, so nothing else
+            # would ever reclaim the (potentially huge) partial payloads
+            if jax.process_index() == 0:
+                for path in abandoned:
+                    shutil.rmtree(path, ignore_errors=True)
+            return
         while _pending:
             path, meta = _pending.pop(0)
-            _write_meta(path, meta)
+            call_with_retry(lambda: _write_meta(path, meta),
+                            desc="checkpoint meta write", counter=retries)
             logger.info("async checkpoint finalized: %s", path)
+
+
+def completed_steps(directory: str) -> list[int]:
+    """Sorted steps with a parseable completion marker under ``directory``.
+
+    Step dirs with a missing or corrupt meta file are skipped with a
+    warning (from ``_read_meta``) instead of crashing the resume path —
+    they are half-written saves that ``save_checkpoint`` cleans up when it
+    next writes that step.
+    """
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name[len("step_"):])
+        except ValueError:
+            continue
+        if _read_meta(os.path.join(directory, name)) is not None:
+            steps.append(step)
+    return sorted(steps)
 
 
 def latest_step(directory: str) -> Optional[int]:
     """Highest completed step under ``directory`` (None if none)."""
-    if not os.path.isdir(directory):
-        return None
-    steps = []
-    for name in os.listdir(directory):
-        if name.startswith("step_"):
-            full = os.path.join(directory, name, _META_NAME)
-            if os.path.exists(full):
-                try:
-                    steps.append(int(name[len("step_"):]))
-                except ValueError:
-                    continue
-    return max(steps) if steps else None
+    steps = completed_steps(directory)
+    return steps[-1] if steps else None
 
 
 def peek_meta(directory: str) -> Optional[dict]:
     """Read the latest checkpoint's meta dict without touching array data —
     used by the CLI to seed the sampler's ``consumed_samples`` before the
-    engine restores the full state."""
+    engine restores the full state. Corrupt metas are skipped (the
+    previous completed step wins)."""
     step = latest_step(directory)
     if step is None:
         return None
-    with open(os.path.join(_step_dir(directory, step), _META_NAME)) as f:
-        return json.load(f)
+    return _read_meta(_step_dir(directory, step))
+
+
+def gc_checkpoints(directory: str, keep_last: int,
+                   keep_every: int = 0) -> int:
+    """Prune old completed step dirs; returns how many were removed.
+
+    Retention: the newest ``keep_last`` completed steps always survive
+    (floored at 1 — the newest completed step is NEVER pruned, it is the
+    resume point), plus every step divisible by ``keep_every`` when set
+    (periodic keep-forever archives). Half-written dirs are not touched —
+    ``save_checkpoint`` owns those. Pruned dirs bump ``ckpt_gc_total``.
+
+    Rank-0 gated (same convention as ``_write_meta``): on multi-host
+    fleets with shared checkpoint storage, N hosts racing ``rmtree`` on
+    the same dirs would leave partially-deleted checkpoints that still
+    look complete.
+    """
+    if jax.process_index() != 0:
+        return 0
+    steps = completed_steps(directory)
+    if not steps:
+        return 0
+    keep = set(steps[-max(int(keep_last), 1):])
+    if keep_every:
+        keep.update(s for s in steps if s % int(keep_every) == 0)
+    pruned = 0
+    for s in steps:
+        if s in keep:
+            continue
+        path = _step_dir(directory, s)
+        logger.info("checkpoint gc: pruning %s", path)
+        shutil.rmtree(path, ignore_errors=True)
+        pruned += 1
+    if pruned:
+        get_registry().counter("ckpt_gc_total").inc(pruned)
+    return pruned
 
 
 def load_params(directory: str, step: Optional[int] = None) -> Any:
@@ -238,7 +393,10 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
     reg = get_registry()
     t0 = time.perf_counter()
     with span("checkpoint_restore", step=int(step)):
-        state = ckptr.restore(os.path.join(path, "state"), request)
+        state = call_with_retry(
+            lambda: ckptr.restore(os.path.join(path, "state"), request),
+            desc="checkpoint restore",
+            counter=reg.counter("ckpt_retries_total"))
     reg.histogram("ckpt_restore").record(time.perf_counter() - t0)
     reg.counter("ckpt_restores_total").inc()
     reg.gauge("ckpt_bytes").set(_tree_bytes(state))
@@ -249,8 +407,14 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
             lambda got, want: jnp_reshape_to(got, want.shape)
             if got.shape != want.shape else got,
             state, abstract_state)
-    with open(os.path.join(path, _META_NAME)) as f:
-        meta = json.load(f)
+    meta = _read_meta(path)
+    if meta is None:
+        # the dir was selected as COMPLETE (latest_step read this meta);
+        # silently substituting {} here would reset consumed_samples to 0
+        # and replay the whole data prefix — fail loudly instead
+        raise RuntimeError(
+            f"checkpoint meta unreadable/corrupt for {path} — refusing to "
+            f"resume without step/consumed_samples")
     logger.info("restored checkpoint: %s (step %d)", path, meta.get("step", step))
     return state, meta
 

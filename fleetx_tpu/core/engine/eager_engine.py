@@ -41,6 +41,7 @@ from fleetx_tpu.observability import Observability
 from fleetx_tpu.observability.trace import ProfilerWindow
 from fleetx_tpu.parallel.mesh import build_mesh
 from fleetx_tpu.parallel.sharding import make_axis_rules, zero_sharding
+from fleetx_tpu.resilience import Resilience, TrainingAborted
 from fleetx_tpu.utils.log import logger
 
 
@@ -125,6 +126,16 @@ class EagerEngine(BasicEngine):
         self.output_dir = save_load.get("output_dir", "./output")
         self.ckpt_dir = save_load.get("ckpt_dir")
         self.async_save = bool(save_load.get("async_save"))
+        # checkpoint retention GC (docs/resilience.md): keep the newest
+        # keep_last completed steps (+ every keep_every-th forever); 0/None
+        # keeps everything
+        self.keep_last = _int(save_load, "keep_last", 0)
+        self.keep_every = _int(save_load, "keep_every", 0)
+
+        # fault-tolerant runtime (docs/resilience.md): retry policy, guard,
+        # watchdog, preemption + fault injection; inert unless the
+        # Resilience block enables it
+        self.resilience = Resilience(self.cfg.get("Resilience"))
 
         mp_cfg = dict(eng.get("mix_precision") or {})
         self.use_fp16_scaler = bool(mp_cfg.get("use_pure_fp16")) and (
@@ -284,6 +295,11 @@ class EagerEngine(BasicEngine):
         accum = self.accumulate_steps
         base_rng = self._base_rng
         use_scaler = self.use_fp16_scaler
+        # guard skip (docs/resilience.md): generalizes the fp16-scaler's
+        # isfinite update-skip to any compute dtype — a non-finite step is
+        # dropped on-device so a single bad batch never poisons the params
+        guard_skip = self.resilience.guard_skip
+        check_finite = use_scaler or guard_skip
         opt_dev_shardings = getattr(self, "_opt_dev_shardings", None)
         opt_host_shardings = (self.state_shardings.opt_state
                               if opt_dev_shardings is not None else None)
@@ -340,10 +356,13 @@ class EagerEngine(BasicEngine):
 
             new_scaler = state.scaler
             new_step = state.step + 1
-            if use_scaler:
-                finite = jnp.isfinite(grad_norm)
-                # skip the update on overflow; grow/backoff the scale
-                # (reference GradScaler semantics, eager_engine.py:157-164)
+            if check_finite:
+                finite = jnp.isfinite(grad_norm) & jnp.isfinite(
+                    metrics["loss"])
+                # skip the update on a non-finite step (fp16 overflow, NaN
+                # loss): revert params/opt to the pre-step values
+                # (reference GradScaler semantics, eager_engine.py:157-164,
+                # extended to every dtype by the resilience guard)
                 new_params = jax.tree.map(
                     lambda new, old: jnp.where(finite, new, old),
                     new_params, state.params)
@@ -351,6 +370,14 @@ class EagerEngine(BasicEngine):
                     lambda new, old: jnp.where(finite, new, old) if
                     getattr(new, "shape", None) == getattr(old, "shape", None)
                     else new, new_opt, state.opt_state)
+                # a skipped step must not advance the LR schedule /
+                # dropout fold-in
+                new_step = state.step + jnp.where(finite, 1, 0).astype(
+                    state.step.dtype)
+                # host-side guard policy reads this at logging windows
+                metrics["finite"] = finite
+            if use_scaler:
+                # grow/backoff the dynamic loss scale
                 tracker = jnp.where(finite, state.scaler.growth_tracker + 1, 0)
                 grow = tracker >= 1000
                 scale = jnp.where(
@@ -361,12 +388,10 @@ class EagerEngine(BasicEngine):
                 new_scaler = ScalerState(loss_scale=scale,
                                          growth_tracker=jnp.where(grow, 0, tracker))
                 metrics["loss_scale"] = scale
-                # a skipped (overflowed) step must not advance the LR
-                # schedule / dropout fold-in (reference GradScaler semantics)
-                new_step = state.step + jnp.where(finite, 1, 0).astype(state.step.dtype)
 
-            # let the host resync its step mirror at logging points (the fp16
-            # scaler skips step increments on overflow)
+            # let the host resync its step mirror at logging points (the
+            # fp16 scaler and the resilience guard skip step increments on
+            # non-finite updates)
             metrics["opt_step"] = new_step
 
             return TrainState(step=new_step, params=new_params,
@@ -397,7 +422,18 @@ class EagerEngine(BasicEngine):
     def fit(self, train_data_loader: Iterable, valid_data_loader=None,
             epoch_num: int = 1):
         """Train loop (reference ``fit``/``_train_one_epoch``,
-        ``eager_engine.py:250-381``)."""
+        ``eager_engine.py:250-381``) with the resilience runtime wired at
+        step boundaries (docs/resilience.md): auto-resume, graceful
+        preemption exit, guard rollback-to-last-good, step watchdog and
+        deterministic fault injection. All of it is inert when the
+        ``Resilience`` block is absent or disabled.
+        """
+        res = self.resilience
+        if res.auto_resume and self.state is None:
+            # locate the latest completed checkpoint and rewind the
+            # loader's sampler BEFORE the first batch is drawn, so the
+            # stream starts at the checkpoint's consumed_samples position
+            self._auto_resume_rewind(train_data_loader)
         it = iter(train_data_loader)
         first = self.module.pretreating_batch(next(it))
         self.prepare(first)
@@ -406,6 +442,9 @@ class EagerEngine(BasicEngine):
         # per-host leading dim times the number of hosts
         global_batch = _leading_dim(first) * jax.process_count()
         start_step = int(jax.device_get(self.state.step))
+        # sample position at fit entry: rollback rewinds relative to this
+        # when the loader has no consumed_samples sampler
+        base_consumed = self._consumed_samples
         if start_step >= self.max_steps:
             logger.info("checkpoint already at step %d >= max_steps", start_step)
             return
@@ -427,11 +466,26 @@ class EagerEngine(BasicEngine):
         self._epoch = self._start_epoch
         final_epoch = [self._start_epoch]
 
-        def batches():
+        from fleetx_tpu.data.prefetch import DevicePrefetcher
+
+        def host_batches(lead=None, lead_iter=None, start_index=start_step):
+            """(epoch, batch) stream with the fault-injection hook on every
+            batch; ``lead``/``lead_iter`` carry the already-drawn first
+            batch + live iterator on the initial pass, while a rollback
+            restart re-iterates the loader from scratch. ``start_index``
+            is the global step the first yielded batch trains at."""
             epoch = self._start_epoch
-            yield epoch, first
-            for b in it:
-                yield epoch, self.module.pretreating_batch(b)
+            index = start_index
+            if lead is not None:
+                yield epoch, res.faults.on_batch(index, lead)
+                index += 1
+                src = lead_iter
+            else:
+                src = iter(train_data_loader)
+            for b in src:
+                yield epoch, res.faults.on_batch(
+                    index, self.module.pretreating_batch(b))
+                index += 1
             while True:  # re-iterate epochs over the same loader
                 epoch += 1
                 final_epoch[0] = epoch
@@ -440,35 +494,160 @@ class EagerEngine(BasicEngine):
                 got = False
                 for b in train_data_loader:
                     got = True
-                    yield epoch, self.module.pretreating_batch(b)
+                    yield epoch, res.faults.on_batch(
+                        index, self.module.pretreating_batch(b))
+                    index += 1
                 if not got:  # one-shot iterator exhausted — stop cleanly
                     return
 
+        # holder so ONE cleanup callback covers every pipeline generation
+        # (rollback rebuilds it mid-fit); loader_iter is the raw loader
+        # iterator feeding the current host generator, closed explicitly on
+        # rollback because fit's own reference keeps it alive past a
+        # generator close
+        holder: dict = {"prefetcher": None, "host_gen": None,
+                        "loader_iter": None}
+
+        def wrap_stream(bi, loader_iter=None):
+            """Optionally wrap a host stream in the device prefetcher
+            (docs/bandwidth_levers.md): a producer thread shards batch N+1
+            while step N is in flight; the consumer-side wait is then pure
+            input starvation."""
+            pf = None
+            if self.prefetch_to_device > 0:
+                pf = DevicePrefetcher(
+                    bi, lambda eb: (eb[0], self.shard_batch(eb[1])),
+                    depth=self.prefetch_to_device, obs=self.obs)
+            holder["prefetcher"] = pf
+            holder["host_gen"] = bi
+            holder["loader_iter"] = loader_iter
+            return bi, pf
+
+        def close_stream() -> bool:
+            """Tear the current input pipeline down DETERMINISTICALLY, in
+            dependency order: prefetcher (joins its producer, leaving the
+            host generator suspended), then the host generator (its
+            GeneratorExit unwinds any loader iterator it created), then
+            the raw loader iterator — whose close joins the DataLoader
+            producer thread, so afterwards nothing can touch the
+            batch_sampler and a rollback may rewind ``consumed_samples``
+            without racing a live producer. Returns False when a producer
+            join timed out (hung I/O): the generators are then left to GC
+            — closing a generator mid-execution on another thread raises —
+            and the no-live-producer guarantee does NOT hold."""
+            ok = True
+            if holder["prefetcher"] is not None:
+                ok = holder["prefetcher"].close()
+                holder["prefetcher"] = None
+                if not ok:
+                    logger.error("prefetch producer did not exit within "
+                                 "its join timeout — leaving the input "
+                                 "pipeline to GC")
+            for key in ("host_gen", "loader_iter"):
+                stream = holder[key]
+                holder[key] = None
+                if ok and stream is not None and hasattr(stream, "close"):
+                    try:
+                        stream.close()
+                    except ValueError:  # generator running on a hung thread
+                        logger.error("input stream still executing at "
+                                     "close — leaving it to GC")
+                        ok = False
+            return ok
+
         with self._ctx(), contextlib.ExitStack() as cleanup:
+            cleanup.callback(close_stream)
+            if res.preemption is not None:
+                # scoped install: previous SIGTERM/SIGINT handlers restored
+                # on every fit exit path
+                cleanup.enter_context(res.preemption.installed())
+            watchdog = res.make_watchdog(on_stall=self.obs.flush)
+            if watchdog is not None:
+                watchdog.start()
+                cleanup.callback(watchdog.stop)
+
+            def wd_quiet():
+                """Suspend the stall detector around known-long host phases
+                (eval / checkpoint / restore) — they are legitimate
+                progress-free time, not hung steps."""
+                return (watchdog.suspended() if watchdog is not None
+                        else contextlib.nullcontext())
             t_last = time.time()
             window = 0
             losses = []
             step = start_step  # host-side mirror of state.step (no per-step sync)
             last_eval = last_save = -1  # fp16 resync can re-visit a step
             self.profiler.arm()  # each fit gets its own trace window
-            batch_iter = iter(batches())
-            prefetcher = None
-            if self.prefetch_to_device > 0:
-                # device-side double buffering: a producer thread shards
-                # batch N+1 while step N is in flight, so the blocking
-                # per-leaf device_put leaves the step critical path; the
-                # consumer-side wait below is pure input starvation. The
-                # cleanup callback releases the producer thread on EVERY
-                # exit (max_steps, exhausted loader, or a raising step).
-                from fleetx_tpu.data.prefetch import DevicePrefetcher
+            batch_iter, prefetcher = wrap_stream(
+                iter(host_batches(lead=first, lead_iter=it)), loader_iter=it)
 
-                prefetcher = DevicePrefetcher(
-                    batch_iter,
-                    lambda eb: (eb[0], self.shard_batch(eb[1])),
-                    depth=self.prefetch_to_device, obs=self.obs)
-                cleanup.callback(prefetcher.close)
+            def preemption_exit():
+                """Graceful shutdown at a step boundary: emergency
+                checkpoint (finalizing any outstanding async save), flush
+                telemetry, exit with the configured code."""
+                logger.warning("preemption: checkpoint-and-exit at step %d",
+                               step)
+                if res.preemption_save and self.state is not None:
+                    with wd_quiet():
+                        self.save()
+                        ckpt_lib.finalize_async_saves()
+                res.registry.counter("preemption_exits").inc()
+                self.obs.flush()
+                raise SystemExit(res.preemption_exit_code)
+
+            def restart_from_last_good():
+                """Guard rollback: restore the newest completed checkpoint,
+                rewind the data position, rebuild the input pipeline.
+                Returns the restored step."""
+                ckpt_lib.finalize_async_saves()
+                good = ckpt_lib.latest_step(self.output_dir)
+                if good is None:
+                    raise TrainingAborted(
+                        f"rollback requested at step {step} but no "
+                        f"completed checkpoint under {self.output_dir}")
+                # tear the whole input pipeline down BEFORE rewinding: the
+                # old DataLoader producer must be joined, or its last
+                # sampler advance could stomp the rewound consumed_samples
+                if not close_stream():
+                    # a hung producer still owns the sampler — a rewind
+                    # now could be silently overwritten; refuse
+                    raise TrainingAborted(
+                        "rollback aborted: the input pipeline did not shut "
+                        "down cleanly, the data position cannot be safely "
+                        "rewound")
+                self.load(self.output_dir)
+                restored = int(jax.device_get(self.state.step))
+                skip = 0
+                if not _rewind_sampler(train_data_loader,
+                                       self._consumed_samples):
+                    # no consumed_samples sampler: re-iterate the loader
+                    # and skip forward to the restored position (needs a
+                    # re-iterable loader — a one-shot iterator is gone)
+                    if iter(train_data_loader) is train_data_loader:
+                        raise TrainingAborted(
+                            "rollback needs a re-iterable data loader or "
+                            "a sampler with consumed_samples")
+                    skip = max((self._consumed_samples - base_consumed)
+                               // global_batch, 0)
+                bi = iter(host_batches(start_index=restored - skip))
+                for _ in range(skip):
+                    if next(bi, None) is None:
+                        raise TrainingAborted(
+                            "data stream exhausted while rewinding for "
+                            "rollback")
+                self._epoch = self._start_epoch
+                final_epoch[0] = self._start_epoch
+                res.registry.counter("rollbacks_total").inc()
+                if res.guard is not None:
+                    res.guard.note_rollback()
+                logger.warning("rolled back to checkpoint step %d", restored)
+                return wrap_stream(bi), restored
+
             metrics: dict = {}
             while step < self.max_steps:
+                res.faults.maybe_sigterm(step, start_step=start_step)
+                if res.preempted:
+                    preemption_exit()
                 if prefetcher is not None:
                     with self.obs.timed_span("data_fetch"):
                         item = next(prefetcher, None)
@@ -498,6 +677,8 @@ class EagerEngine(BasicEngine):
                 window += 1
                 self._consumed_samples += global_batch
                 step += 1
+                if watchdog is not None:
+                    watchdog.beat(step)
                 if window % self.logging_freq == 0:
                     # ONE device->host sync per logging window: fetch the
                     # whole metrics pytree at once and convert on the host,
@@ -506,7 +687,8 @@ class EagerEngine(BasicEngine):
                     # `metrics` stays a device pytree for the profiler sync.
                     host_metrics = jax.device_get(metrics)
                     # resync with the device step counter: under the fp16
-                    # scaler, overflowed steps don't advance state.step
+                    # scaler (and the guard's in-step skip), non-finite
+                    # steps don't advance state.step
                     step = int(host_metrics.get("opt_step", step))
                     now = time.time()
                     cost = (now - t_last) / self.logging_freq
@@ -522,17 +704,44 @@ class EagerEngine(BasicEngine):
                     }
                     self.module.training_step_end(log_dict)
                     self._emit_train_record(log_dict, host_metrics)
+                    if res.guard is not None:
+                        fin = host_metrics.get("finite")
+                        decision = res.guard.observe(
+                            step, loss,
+                            finite=None if fin is None else bool(fin))
+                        if decision == "rollback":
+                            with wd_quiet():
+                                (batch_iter, prefetcher), step = \
+                                    restart_from_last_good()
+                            if self.logging_freq == 1:
+                                # keep the returned curve consistent with
+                                # the rewound step counter (exact only at
+                                # one window per step)
+                                del losses[max(step - start_step, 0):]
+                            window = 0
+                            t_last = time.time()
+                            # the replayed trajectory must re-save/re-eval
+                            # at step numbers the abandoned run already
+                            # visited — stale markers would suppress them
+                            last_eval = last_save = step
+                            continue
+                        if decision == "abort":
+                            raise TrainingAborted(
+                                f"training guard abort at step {step} "
+                                f"(loss={loss})")
                 # profiler stop drains in-flight device work via the step's
                 # loss value so the trace tail isn't truncated
                 self.profiler.maybe_stop(step, sync=metrics.get("loss"))
                 if self.eval_freq and valid_data_loader is not None and \
                         step % self.eval_freq == 0 and step != last_eval:
                     last_eval = step
-                    self.evaluate(valid_data_loader, global_step=step)
+                    with wd_quiet():
+                        self.evaluate(valid_data_loader, global_step=step)
                 if self.save_steps and step % self.save_steps == 0 and \
                         step != last_save:
                     last_save = step
-                    self.save()
+                    with wd_quiet():
+                        self.save()
                 if self._fault_step and start_step == 0 and \
                         step >= self._fault_step:
                     # fault injection (tests/tools/supervise.py): die hard on
@@ -543,6 +752,9 @@ class EagerEngine(BasicEngine):
             self.profiler.stop(sync=metrics.get("loss")
                                if isinstance(metrics, dict) else None)
             ckpt_lib.finalize_async_saves()
+            if self.keep_last:
+                ckpt_lib.gc_checkpoints(self.output_dir, self.keep_last,
+                                        self.keep_every)
             self.obs.flush()
             return losses
 
@@ -654,12 +866,47 @@ class EagerEngine(BasicEngine):
         # span only: the duration/bytes histograms live in checkpoint.py
         # (ckpt_save/ckpt_bytes), which also covers non-engine callers
         with self.obs.span("checkpoint_save", step=step):
-            return ckpt_lib.save_checkpoint(
+            path = ckpt_lib.save_checkpoint(
                 self.output_dir, step, meta.unbox(self.state),
                 meta={"consumed_samples": self._consumed_samples,
                       "epoch": getattr(self, "_epoch", self._start_epoch),
                       "seed": self.seed},
                 async_save=self.async_save)
+        if self.keep_last:
+            # retention GC considers only COMPLETED step dirs and never
+            # prunes the newest one, so an in-flight async save (meta not
+            # yet written) is never touched
+            ckpt_lib.gc_checkpoints(self.output_dir, self.keep_last,
+                                    self.keep_every)
+        return path
+
+    def _auto_resume_rewind(self, loader) -> None:
+        """Auto-resume orchestration (docs/resilience.md): find the latest
+        completed checkpoint, point ``ckpt_dir`` at it so ``prepare()``
+        restores it, and rewind the loader's ``consumed_samples`` sampler
+        BEFORE the first batch is drawn so the data stream resumes at the
+        checkpoint's exact sample position."""
+        target = self.ckpt_dir or self.output_dir
+        meta_d = ckpt_lib.peek_meta(target) if target else None
+        if not meta_d:
+            return
+        self.ckpt_dir = target
+        consumed = int(meta_d.get("consumed_samples", 0))
+        if _rewind_sampler(loader, consumed):
+            logger.info("auto-resume: sampler rewound to "
+                        "consumed_samples=%d", consumed)
+        elif consumed:
+            # without a consumed_samples sampler the data position cannot
+            # be verified — the caller must hand a stream already
+            # positioned at `consumed` (tools/train.py does), otherwise
+            # already-trained data silently replays
+            logger.warning(
+                "auto-resume: loader has no consumed_samples sampler — "
+                "assuming the stream is already positioned at global "
+                "sample %d (pass a GPTBatchSampler-style loader for "
+                "automatic rewind)", consumed)
+        logger.info("auto-resume: restoring step %s from %s",
+                    meta_d.get("step"), target)
 
     def load(self, directory: Optional[str] = None):
         """Restore the latest checkpoint (reference ``eager_engine.py:617-660``).
@@ -694,6 +941,17 @@ class EagerEngine(BasicEngine):
 
 
 # ------------------------------------------------------------------ helpers
+
+def _rewind_sampler(loader: Any, consumed: int) -> bool:
+    """Point a ``consumed_samples`` sampler (the ``GPTBatchSampler``
+    protocol, ``data/sampler/batch_sampler.py``) at an absolute global
+    sample position; False when the loader carries no such sampler."""
+    sampler = getattr(loader, "batch_sampler", None)
+    if sampler is not None and hasattr(sampler, "consumed_samples"):
+        sampler.consumed_samples = int(consumed)
+        return True
+    return False
+
 
 def _host_batch(batch: dict) -> dict:
     return jax.tree.map(np.asarray, batch)

@@ -121,6 +121,21 @@ class DataLoader:
                 yield item
         finally:
             q.stop()
+            # deterministic shutdown: once close()/GC of this generator
+            # returns, the producer has exited and will never touch the
+            # batch_sampler again — a rollback can then safely rewind
+            # sampler.consumed_samples without racing a live producer
+            # (docs/resilience.md); stop-aware puts bound the join. A
+            # timed-out join (dataset read hung on I/O) is logged loudly
+            # because that guarantee then does NOT hold.
+            t.join(timeout=5.0)
+            if t.is_alive():
+                from fleetx_tpu.utils.log import logger
+
+                logger.error(
+                    "dataloader producer did not exit within its join "
+                    "timeout — batch_sampler may still be advanced by the "
+                    "hung thread")
 
     def __len__(self) -> int:
         return len(self.batch_sampler)

@@ -91,8 +91,17 @@ class DevicePrefetcher:
             raise item.exc
         return item
 
-    def close(self) -> None:
-        """Release the producer thread (idempotent; safe mid-iteration)."""
+    def close(self) -> bool:
+        """Release the producer thread (idempotent; safe mid-iteration).
+
+        Returns True when the producer actually exited — False means the
+        join timed out (e.g. ``shard_fn`` or the host iterator is hung on
+        I/O) and the underlying host iterator is STILL EXECUTING on the
+        producer thread: callers must not close() that generator (it would
+        raise ``ValueError: generator already executing``) nor assume
+        exclusive access to its sampler.
+        """
         self._queue.stop()
         self._queue.drain()  # unblock a producer waiting in put()
         self._thread.join(timeout=5.0)
+        return not self._thread.is_alive()

@@ -1,0 +1,121 @@
+"""Fault-tolerant training runtime (docs/resilience.md).
+
+FleetX's value proposition is keeping thousand-chip runs alive; the
+reference delegates all fault handling to the Paddle substrate. This
+package owns it natively, one module per failure mode:
+
+- ``policy``     — retry/backoff-with-jitter + transient-vs-fatal
+  classification (checkpoint I/O, downloads);
+- ``preemption`` — SIGTERM/SIGINT → graceful checkpoint-and-exit at the
+  next step boundary;
+- ``guard``      — non-finite-streak / loss-spike policy with
+  ``skip | rollback | abort`` actions;
+- ``watchdog``   — hung-step heartbeat with stack dumps;
+- ``faults``     — deterministic fault injection driving the tests.
+
+``Resilience`` is the engine-facing facade built from the ``Resilience:``
+YAML block (``utils/config.py``): with the block absent or disabled every
+hook is a no-op and the train loop is byte-identical to the pre-resilience
+engine. All recovery events surface as counters in the shared
+observability registry (``nonfinite_skips``, ``rollbacks_total``,
+``ckpt_retries_total``, ``preemption_exits``, ``watchdog_stalls``,
+``ckpt_gc_total``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fleetx_tpu.observability.metrics import get_registry
+from fleetx_tpu.resilience import faults as faults_mod
+from fleetx_tpu.resilience.faults import FaultPlan, InjectedFault  # noqa: F401
+from fleetx_tpu.resilience.guard import (  # noqa: F401
+    TrainingAborted, TrainingGuard)
+from fleetx_tpu.resilience.policy import (  # noqa: F401
+    RetryPolicy, call_with_retry, is_transient, set_default_policy)
+from fleetx_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
+from fleetx_tpu.resilience.watchdog import StepWatchdog  # noqa: F401
+
+__all__ = [
+    "Resilience", "RetryPolicy", "TrainingGuard", "TrainingAborted",
+    "PreemptionHandler", "StepWatchdog", "FaultPlan", "InjectedFault",
+    "call_with_retry", "is_transient", "set_default_policy",
+]
+
+
+def _on(value, default: bool = True) -> bool:
+    """A config value as a bool, with ``None``/absent meaning ``default``
+    — the YAML zoo leaves opt-out knobs empty rather than writing
+    ``false``. Takes the looked-up VALUE (callers keep the literal
+    ``cfg.get("key")``) so fleetx-lint's dead-config-key rule still sees
+    every key consumed at its call site."""
+    return default if value is None else bool(value)
+
+
+class Resilience:
+    """Engine-facing facade over retry policy, guard, watchdog, preemption
+    and fault injection.
+
+    Built once per engine from the ``Resilience:`` config block. When the
+    block is absent or ``enable`` is false, every attribute is inert — no
+    signal handlers, no threads, no step-fn changes — and the process-wide
+    fault plan / retry policy are reset to defaults so nothing leaks in
+    from a previously-built engine.
+    """
+
+    def __init__(self, cfg: Optional[dict] = None):
+        cfg = dict(cfg or {})
+        self.enabled = bool(cfg.get("enable"))
+        self.registry = get_registry()
+        self.auto_resume = self.enabled and _on(cfg.get("auto_resume"))
+        self.retry_policy = RetryPolicy.from_cfg(cfg.get("retry"))
+        self.guard: Optional[TrainingGuard] = None
+        self.guard_skip = False
+        self.preemption: Optional[PreemptionHandler] = None
+        self.preemption_save = True
+        self.preemption_exit_code = 0
+        self.watchdog_enabled = False
+        self._watchdog_cfg: dict = {}
+        self.faults = FaultPlan()
+        if not self.enabled:
+            # inert AND isolating: a disabled engine must not inherit a
+            # previous engine's armed fault plan or tuned retry policy
+            # (the globals are engine-scoped; the newest engine wins)
+            faults_mod.install_plan(None)
+            set_default_policy(None)
+            return
+        # the process-wide default policy: checkpoint.py / download.py
+        # retry under the engine's Resilience.retry settings
+        set_default_policy(self.retry_policy)
+        guard_cfg = dict(cfg.get("guard") or {})
+        if _on(guard_cfg.get("enable")):
+            # extend the fp16-only in-step isfinite skip to every dtype:
+            # a non-finite update is dropped on-device, params survive
+            self.guard_skip = _on(guard_cfg.get("skip_nonfinite_update"))
+            self.guard = TrainingGuard.from_cfg(guard_cfg,
+                                                skip_active=self.guard_skip,
+                                                registry=self.registry)
+        pre_cfg = dict(cfg.get("preemption") or {})
+        if _on(pre_cfg.get("enable")):
+            self.preemption = PreemptionHandler(pre_cfg.get("signals"))
+        self.preemption_save = _on(pre_cfg.get("save_on_exit"))
+        self.preemption_exit_code = int(pre_cfg.get("exit_code") or 0)
+        wd_cfg = dict(cfg.get("watchdog") or {})
+        self.watchdog_enabled = bool(wd_cfg.get("enable"))
+        self._watchdog_cfg = wd_cfg
+        self.faults = FaultPlan.from_cfg(cfg.get("faults"))
+        # module-level install so core/checkpoint.py's injection point
+        # fires without config plumbing (cleared when this plan is unarmed)
+        faults_mod.install_plan(self.faults)
+
+    @property
+    def preempted(self) -> bool:
+        """True once a graceful-shutdown signal has been latched."""
+        return self.preemption is not None and self.preemption.triggered
+
+    def make_watchdog(self, on_stall=None) -> Optional[StepWatchdog]:
+        """A fresh (un-started) watchdog per fit, or None when disabled."""
+        if not (self.enabled and self.watchdog_enabled):
+            return None
+        return StepWatchdog.from_cfg(self._watchdog_cfg, on_stall=on_stall,
+                                     registry=self.registry)

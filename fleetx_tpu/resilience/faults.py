@@ -1,0 +1,165 @@
+"""Deterministic fault injection: rehearse failures before production does.
+
+A fault-tolerance layer that has never seen a fault is untested code on
+the critical path. ``FaultPlan`` injects the three dominant large-run
+failure modes at exact, reproducible points so the resilience tests drive
+the REAL recovery machinery end-to-end:
+
+- ``data_raise_at: K``       — raise from the data path at batch index K
+  (a flaky storage read / corrupt shard);
+- ``nan_loss_at: [K, ...]``  — poison the batch's ``loss_mask`` with NaN
+  at those indices, producing a genuinely non-finite device loss (a loss
+  blow-up, exercised through the full jitted step);
+- ``sigterm_at: K``          — SIGTERM our own process before step K (a
+  TPU-pool preemption);
+- ``ckpt_write_fail_times: N`` — the first N checkpoint writes raise a
+  transient ``InjectedFault(OSError)`` (an I/O blip the retry policy must
+  absorb).
+
+Plans come from the ``Resilience.faults`` config block or the
+``FLEETX_FAULTS`` env var (``"sigterm_at=5,ckpt_write_fail_times=1,
+nan_loss_at=4:5"``), env winning — so a restart harness can inject into an
+unmodified recipe. A module-level active plan lets deep layers
+(``core/checkpoint.py``) consult injection points without config plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Optional
+
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["FaultPlan", "InjectedFault", "install_plan", "active_plan",
+           "fire"]
+
+ENV_VAR = "FLEETX_FAULTS"
+
+
+class InjectedFault(OSError):
+    """Injected transient failure — an ``OSError`` so the retry policy
+    classifies it exactly like the real I/O error it stands in for."""
+
+
+def _parse_env(spec: str) -> dict:
+    """``k=v,k=v`` with ``:``-separated int lists → a faults config dict."""
+    out: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        key, value = part.split("=", 1)
+        if ":" in value:
+            out[key.strip()] = [int(v) for v in value.split(":") if v]
+        else:
+            out[key.strip()] = int(value)
+    return out
+
+
+class FaultPlan:
+    """One run's worth of armed faults; all methods are cheap no-ops when
+    the corresponding fault is not armed."""
+
+    def __init__(self, data_raise_at: Optional[int] = None,
+                 nan_loss_at: Optional[list] = None,
+                 sigterm_at: Optional[int] = None,
+                 ckpt_write_fail_times: int = 0):
+        self.data_raise_at = data_raise_at
+        self.nan_loss_at = set(int(s) for s in (nan_loss_at or ()))
+        self.sigterm_at = sigterm_at
+        self.ckpt_write_fail_times = int(ckpt_write_fail_times or 0)
+
+    @classmethod
+    def from_cfg(cls, cfg: Optional[dict],
+                 env: Optional[str] = None) -> "FaultPlan":
+        """Merge the config block and the env spec (env wins per key)."""
+        merged = dict(cfg or {})
+        env = os.environ.get(ENV_VAR) if env is None else env
+        if env:
+            merged.update(_parse_env(env))
+        nan_at = merged.get("nan_loss_at")
+        if isinstance(nan_at, int):
+            nan_at = [nan_at]
+        return cls(
+            data_raise_at=(None if merged.get("data_raise_at") is None
+                           else int(merged["data_raise_at"])),
+            nan_loss_at=nan_at,
+            sigterm_at=(None if merged.get("sigterm_at") is None
+                        else int(merged["sigterm_at"])),
+            ckpt_write_fail_times=int(merged.get("ckpt_write_fail_times")
+                                      or 0))
+
+    @property
+    def armed(self) -> bool:
+        """True when any fault is configured."""
+        return bool(self.data_raise_at is not None or self.nan_loss_at
+                    or self.sigterm_at is not None
+                    or self.ckpt_write_fail_times)
+
+    # ------------------------------------------------------------- triggers
+    def on_batch(self, index: int, batch: Any) -> Any:
+        """Data-path hook: raise or poison at batch ``index`` (the engine's
+        global step numbering), else pass ``batch`` through untouched."""
+        if self.data_raise_at is not None and index == self.data_raise_at:
+            self.data_raise_at = None  # once
+            raise InjectedFault(
+                f"injected data-path failure at batch {index}")
+        if index in self.nan_loss_at and isinstance(batch, dict) and \
+                "loss_mask" in batch:
+            logger.warning("fault injection: NaN loss_mask at batch %d",
+                           index)
+            mask = np.asarray(batch["loss_mask"], dtype=np.float32).copy()
+            mask[...] = np.nan
+            batch = dict(batch, loss_mask=mask)
+        return batch
+
+    def maybe_sigterm(self, step: int, start_step: int = 0) -> None:
+        """Send SIGTERM to our own process before step ``step`` (once).
+
+        Fires on FRESH runs only (``start_step == 0``, same gate as the
+        legacy ``FLEETX_FAULT_STEP`` hook): a resumed process must sail
+        past the injection point, otherwise a supervisor re-running the
+        same command re-kills the run at its own resume step forever.
+        """
+        if start_step:
+            return
+        if self.sigterm_at is not None and step >= self.sigterm_at:
+            self.sigterm_at = None
+            logger.warning("fault injection: SIGTERM self at step %d", step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def fire(self, point: str) -> None:
+        """Named-point hook for deep layers (``"ckpt_write"``)."""
+        if point == "ckpt_write" and self.ckpt_write_fail_times > 0:
+            self.ckpt_write_fail_times -= 1
+            raise InjectedFault("injected checkpoint-write failure")
+
+
+# ---------------------------------------------------------------------------
+# Module-level active plan (checkpoint.py consults it without plumbing)
+# ---------------------------------------------------------------------------
+
+_active: Optional[FaultPlan] = None
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) the process-wide fault plan."""
+    global _active
+    _active = plan if plan is not None and plan.armed else None
+    if _active is not None:
+        logger.warning("fault-injection plan armed: %s", vars(plan))
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The armed process-wide plan, if any."""
+    return _active
+
+
+def fire(point: str) -> None:
+    """Trigger the named injection point on the active plan (no-op when
+    nothing is armed) — the one-liner deep layers call."""
+    if _active is not None:
+        _active.fire(point)

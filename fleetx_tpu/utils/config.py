@@ -30,6 +30,7 @@ __all__ = [
     "process_dist_config",
     "process_global_configs",
     "process_observability_config",
+    "process_resilience_config",
     "print_config",
 ]
 
@@ -247,6 +248,21 @@ def process_observability_config(config: AttrDict) -> AttrDict:
     return config
 
 
+def process_resilience_config(config: AttrDict) -> AttrDict:
+    """Ensure the ``Resilience`` block exists (docs/resilience.md).
+
+    Same stance as ``process_observability_config``: only ``enable``
+    (opt-in, default False — fault handling never changes a recipe's
+    behaviour silently) is materialised so ``print_config`` shows the
+    switch; per-knob defaults live in ONE place,
+    ``resilience.Resilience`` and its component classes, which engines
+    also reach without ``get_config``.
+    """
+    res = config.setdefault("Resilience", AttrDict())
+    res.setdefault("enable", False)
+    return config
+
+
 def get_config(fname: str, overrides: list[str] | None = None, show: bool = False,
                num_devices: int | None = None, auto_layout: bool = False) -> AttrDict:
     """Load + override + post-process a config (reference ``config.py:313-345``).
@@ -305,6 +321,7 @@ def get_config(fname: str, overrides: list[str] | None = None, show: bool = Fals
     process_global_configs(config)
     process_engine_config(config)
     process_observability_config(config)
+    process_resilience_config(config)
     if show:
         print_config(config)
     return config

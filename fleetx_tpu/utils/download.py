@@ -13,10 +13,19 @@ from __future__ import annotations
 import hashlib
 import os
 import shutil
+import urllib.error
 import urllib.parse
 import urllib.request
 
+from fleetx_tpu.observability.metrics import get_registry
+from fleetx_tpu.resilience.policy import call_with_retry
 from fleetx_tpu.utils.log import logger
+
+
+class _PermanentDownloadError(Exception):
+    """A client-side HTTP failure (404/403/...) — deliberately NOT an
+    ``OSError`` so the retry policy classifies it as fatal: re-fetching a
+    dead URL only delays the air-gap guidance below."""
 
 DEFAULT_CACHE = os.path.join(os.path.expanduser("~"), ".cache", "fleetx_tpu")
 
@@ -44,14 +53,32 @@ def cached_path(url_or_path: str, sub_dir: str = "") -> str:
 
     tmp = target + f".tmp.{os.getpid()}"
     logger.info("downloading %s -> %s", url_or_path, target)
+
+    def _fetch_once():
+        # raises OSError subclasses (URLError, timeouts, disk errors) —
+        # exactly what the retry policy classifies as transient; permanent
+        # HTTP client errors (4xx other than 429) are re-raised as fatal
+        try:
+            with urllib.request.urlopen(url_or_path, timeout=60) as resp, \
+                    open(tmp, "wb") as out:
+                shutil.copyfileobj(resp, out)
+            os.replace(tmp, target)
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500 and e.code != 429:
+                raise _PermanentDownloadError(f"HTTP {e.code}: {e}") from e
+            raise  # 5xx / 429 stay OSError-transient
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
     try:
-        with urllib.request.urlopen(url_or_path, timeout=60) as resp, \
-                open(tmp, "wb") as out:
-            shutil.copyfileobj(resp, out)
-        os.replace(tmp, target)
+        # transient network/disk blips retry under the process-wide policy
+        # (resilience/policy.py); exhausted retries fall through to the
+        # air-gap guidance below
+        call_with_retry(_fetch_once, desc=f"download {url_or_path}",
+                        counter=get_registry().counter(
+                            "download_retries_total"))
     except Exception as e:
-        if os.path.exists(tmp):
-            os.remove(tmp)
         raise RuntimeError(
             f"could not download {url_or_path} ({e}); in air-gapped "
             f"environments place the file at {target} manually") from e

@@ -1,0 +1,604 @@
+"""Fault-tolerant runtime: the fault-injection matrix (docs/resilience.md).
+
+Every recovery path is driven end-to-end through the REAL machinery by the
+deterministic fault harness (``fleetx_tpu/resilience/faults.py``):
+SIGTERM-at-step-K then auto-resume reproduces the uninterrupted loss curve,
+an injected transient checkpoint-write failure is absorbed by the retry
+policy, and a non-finite streak triggers rollback-to-last-good and then an
+abort once the rollback budget is spent.
+"""
+
+import io
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import fleetx_tpu.core.checkpoint as ckpt_lib
+from fleetx_tpu.core.checkpoint import (completed_steps, gc_checkpoints,
+                                        latest_step, peek_meta)
+from fleetx_tpu.observability.metrics import MetricsRegistry, get_registry
+from fleetx_tpu.parallel.mesh import build_mesh
+from fleetx_tpu.resilience import (FaultPlan, InjectedFault, PreemptionHandler,
+                                   Resilience, RetryPolicy, StepWatchdog,
+                                   TrainingAborted, TrainingGuard,
+                                   call_with_retry, set_default_policy)
+from fleetx_tpu.resilience import faults as faults_mod
+
+from test_engine import build_engine, make_batches, tiny_cfg
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(autouse=True)
+def _isolate_process_state():
+    """Clear the module-level fault plan and retry policy after each test so
+    an armed plan can never leak into another suite's checkpoint saves."""
+    yield
+    faults_mod.install_plan(None)
+    set_default_policy(None)
+
+
+def _counter(name):
+    return get_registry().counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+def test_retry_absorbs_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("blip")
+        return 42
+
+    reg = MetricsRegistry()
+    pol = RetryPolicy(max_attempts=3, backoff_s=0.0, jitter=0.0)
+    assert call_with_retry(flaky, policy=pol,
+                           counter=reg.counter("retries")) == 42
+    assert len(calls) == 3
+    assert reg.counter("retries").value == 2
+
+
+def test_retry_fatal_raises_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("a logic bug, not an I/O blip")
+
+    pol = RetryPolicy(max_attempts=5, backoff_s=0.0, jitter=0.0)
+    with pytest.raises(ValueError):
+        call_with_retry(broken, policy=pol)
+    assert len(calls) == 1  # never retried
+
+
+def test_retry_exhaustion_reraises_last_error():
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise OSError("still down")
+
+    pol = RetryPolicy(max_attempts=2, backoff_s=0.0, jitter=0.0)
+    with pytest.raises(OSError):
+        call_with_retry(always_down, policy=pol)
+    assert len(calls) == 2
+
+
+def test_backoff_exponential_with_jitter_bounds():
+    pol = RetryPolicy(max_attempts=9, backoff_s=1.0, max_backoff_s=4.0,
+                      jitter=0.5)
+    for attempt in range(1, 6):
+        base = min(2.0 ** (attempt - 1), 4.0)
+        for _ in range(8):
+            got = pol.sleep_for(attempt)
+            assert 0.5 * base <= got <= 1.5 * base
+    # jitter 0 is exact
+    exact = RetryPolicy(backoff_s=1.0, max_backoff_s=4.0, jitter=0.0)
+    assert [exact.sleep_for(a) for a in (1, 2, 3, 4)] == [1.0, 2.0, 4.0, 4.0]
+
+
+def test_download_retries_transient_urlerror(tmp_path, monkeypatch):
+    from fleetx_tpu.utils.download import cached_path
+
+    calls = []
+
+    class Resp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def fake_urlopen(url, timeout=0):
+        calls.append(1)
+        if len(calls) == 1:
+            raise urllib.error.URLError("net down")
+        return Resp(b"payload")
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    monkeypatch.setenv("FLEETX_CACHE", str(tmp_path))
+    set_default_policy(RetryPolicy(max_attempts=2, backoff_s=0.0, jitter=0.0))
+    path = cached_path("http://example.invalid/shard.bin")
+    with open(path, "rb") as f:
+        assert f.read() == b"payload"
+    assert len(calls) == 2
+
+
+def test_download_404_fails_fast_not_retried(tmp_path, monkeypatch):
+    """Permanent HTTP client errors must not be classified transient —
+    re-fetching a dead URL only delays the air-gap guidance."""
+    from fleetx_tpu.utils.download import cached_path
+
+    calls = []
+
+    def fake_urlopen(url, timeout=0):
+        calls.append(1)
+        raise urllib.error.HTTPError(url, 404, "not found", None, None)
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    monkeypatch.setenv("FLEETX_CACHE", str(tmp_path))
+    set_default_policy(RetryPolicy(max_attempts=3, backoff_s=0.0, jitter=0.0))
+    with pytest.raises(RuntimeError):
+        cached_path("http://example.invalid/gone.bin")
+    assert len(calls) == 1  # fatal: no retries
+
+
+def test_finalize_abandons_on_sticky_commit_failure(tmp_path, monkeypatch):
+    """A sticky async-commit failure abandons the pending save instead of
+    killing training: ckpt_failed_total records the loss and the
+    half-written dir is removed immediately (periodic saves never revisit
+    that step — nothing else would reclaim the partial payload)."""
+    class BrokenCkptr:
+        def wait_until_finished(self):
+            raise OSError("storage gone")
+
+    step_dir = str(tmp_path / "step_7")
+    os.makedirs(step_dir)
+    monkeypatch.setattr(ckpt_lib, "_get_checkpointer", lambda: BrokenCkptr())
+    monkeypatch.setattr(ckpt_lib, "_pending", [(step_dir, {"step": 7})])
+    before = _counter("ckpt_failed_total")
+    ckpt_lib.finalize_async_saves()  # must NOT raise
+    assert _counter("ckpt_failed_total") - before == 1
+    assert not ckpt_lib._pending
+    assert not os.path.exists(step_dir)  # partial payload reclaimed
+    assert latest_step(str(tmp_path)) is None  # never marked complete
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening: atomic meta, corrupt meta, retention GC
+# ---------------------------------------------------------------------------
+
+def test_write_meta_is_atomic(tmp_path, monkeypatch):
+    """A crash mid-json.dump must leave NO meta file (a truncated one would
+    count as a complete checkpoint) and no temp litter."""
+    step_dir = tmp_path / "step_5"
+    step_dir.mkdir()
+
+    def boom(obj, fh):
+        fh.write('{"step"')  # partial write, then the "crash"
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt_lib.json, "dump", boom)
+    with pytest.raises(OSError):
+        ckpt_lib._write_meta(str(step_dir), {"step": 5})
+    assert not (step_dir / "fleetx_meta.json").exists()
+    assert not any(".tmp" in name for name in os.listdir(step_dir))
+
+
+def _fake_completed(directory, step, meta=None):
+    path = os.path.join(str(directory), f"step_{step}")
+    os.makedirs(path, exist_ok=True)
+    ckpt_lib._write_meta(path, dict(meta or {}, step=step))
+    return path
+
+
+def test_corrupt_meta_skipped_not_crashing(tmp_path):
+    out = tmp_path / "ckpt"
+    _fake_completed(out, 2, {"consumed_samples": 16})
+    # a truncated meta (pre-atomic-write crash shape) and an empty one
+    for bad_step, content in ((4, '{"step": 4'), (6, "")):
+        bad = out / f"step_{bad_step}"
+        bad.mkdir(parents=True)
+        (bad / "fleetx_meta.json").write_text(content)
+    assert latest_step(str(out)) == 2  # corrupt dirs skipped with a warning
+    meta = peek_meta(str(out))
+    assert meta["step"] == 2 and meta["consumed_samples"] == 16
+
+
+def test_gc_retention_keep_last_and_keep_every(tmp_path):
+    out = str(tmp_path / "ckpt")
+    for s in range(1, 7):
+        _fake_completed(out, s)
+    before = _counter("ckpt_gc_total")
+    pruned = gc_checkpoints(out, keep_last=2, keep_every=3)
+    assert pruned == 3  # 1, 2, 4 pruned; 3 and 6 kept by keep_every
+    assert completed_steps(out) == [3, 5, 6]
+    assert _counter("ckpt_gc_total") - before == 3
+    # keep_last floors at 1: the newest completed step is never pruned
+    gc_checkpoints(out, keep_last=0)
+    assert completed_steps(out) == [6]
+
+
+def test_engine_prunes_checkpoints_with_keep_last(tmp_path, devices8):
+    out = str(tmp_path / "ckpt")
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = 4
+    cfg["Engine"]["save_load"] = {"output_dir": out, "save_steps": 1,
+                                  "keep_last": 2}
+    eng = build_engine(cfg, mesh)
+    eng.fit(make_batches(4, seed=5))
+    assert completed_steps(out) == [3, 4]
+
+
+# ---------------------------------------------------------------------------
+# fault plan parsing
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_env_overrides_config():
+    plan = FaultPlan.from_cfg({"sigterm_at": 9, "data_raise_at": 1},
+                              env="ckpt_write_fail_times=2,nan_loss_at=1:2,"
+                                  "sigterm_at=5")
+    assert plan.sigterm_at == 5  # env wins per key
+    assert plan.data_raise_at == 1  # config keys without env override stay
+    assert plan.ckpt_write_fail_times == 2
+    assert plan.nan_loss_at == {1, 2}
+    assert plan.armed
+    assert not FaultPlan.from_cfg(None, env="").armed
+
+
+# ---------------------------------------------------------------------------
+# preemption + watchdog units
+# ---------------------------------------------------------------------------
+
+def test_sigterm_injection_skipped_on_resumed_run():
+    """A resumed process (start_step > 0) must sail past the injected
+    SIGTERM — otherwise a supervisor re-running the same command (env
+    still set) re-kills the run at its own resume step forever."""
+    plan = FaultPlan(sigterm_at=2)
+    plan.maybe_sigterm(5, start_step=3)  # would kill us if it fired
+    assert plan.sigterm_at == 2  # not consumed: fresh-run-only gate held
+
+
+def test_disabled_facade_clears_leaked_globals():
+    """Building a disabled engine must reset the process-wide fault plan
+    and retry policy left behind by a previous (aborted) enabled engine."""
+    Resilience({"enable": True, "faults": {"ckpt_write_fail_times": 5}})
+    assert faults_mod.active_plan() is not None
+    Resilience({"enable": False})
+    assert faults_mod.active_plan() is None
+    faults_mod.fire("ckpt_write")  # no-op now — must not raise
+
+
+def test_watchdog_unarmed_until_first_beat():
+    """The detector must not fire between start() and the first beat —
+    that window is the first step's XLA compile, however long it takes."""
+    reg = MetricsRegistry()
+    wd = StepWatchdog(stall_factor=2.0, min_timeout_s=0.05, poll_s=0.01,
+                      action="log", registry=reg)
+    wd.start()
+    try:
+        time.sleep(0.3)  # way past min_timeout_s, but no beat yet
+        assert reg.counter("watchdog_stalls").value == 0
+        wd.beat(1)  # arms the detector
+        time.sleep(0.3)
+        assert reg.counter("watchdog_stalls").value == 1
+    finally:
+        wd.stop()
+
+
+def test_preemption_handler_latches_and_restores():
+    prev = signal.getsignal(signal.SIGUSR1)
+    h = PreemptionHandler(["SIGUSR1"])
+    with h.installed():
+        assert not h.triggered
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert h.triggered
+    assert signal.getsignal(signal.SIGUSR1) is prev
+    h.reset()
+    assert not h.triggered
+
+
+def test_preemption_second_signal_restores_default_behaviour():
+    """If the graceful exit never comes (hung step), a second Ctrl-C must
+    regain its normal teeth instead of being swallowed by the latch."""
+    h = PreemptionHandler(["SIGINT"])
+    with h.installed():
+        os.kill(os.getpid(), signal.SIGINT)  # latched, no exception
+        assert h.triggered
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)  # default handler restored
+
+
+def test_watchdog_suspended_covers_long_host_phases():
+    """eval/checkpoint/restore phases are progress-free but legitimate —
+    suspended() must keep the detector quiet THROUGH the phase (a beat
+    after the phase would be too late) and restart the clock after."""
+    reg = MetricsRegistry()
+    reg.histogram("step_time").record(0.01)
+    wd = StepWatchdog(stall_factor=2.0, min_timeout_s=0.05, poll_s=0.01,
+                      action="log", registry=reg)
+    wd.start()
+    try:
+        wd.beat(1)
+        with wd.suspended():
+            time.sleep(0.3)  # way past the threshold, mid-"checkpoint"
+            assert reg.counter("watchdog_stalls").value == 0
+        time.sleep(0.02)  # clock restarted at resume: still quiet
+        assert reg.counter("watchdog_stalls").value == 0
+        time.sleep(0.3)  # now a REAL stall after the phase
+        assert reg.counter("watchdog_stalls").value == 1
+    finally:
+        wd.stop()
+
+
+def test_load_checkpoint_refuses_unreadable_meta(tmp_path):
+    """A dir selected as complete whose meta then turns unreadable must
+    fail loudly — substituting {} would reset consumed_samples to 0 and
+    silently replay the whole data prefix."""
+    out = str(tmp_path / "ckpt")
+    state = {"a": np.arange(4, dtype=np.float32)}
+    ckpt_lib.save_checkpoint(out, 1, state)
+    assert latest_step(out) == 1
+    # meta corrupted between selection and the restore's read
+    with open(os.path.join(out, "step_1", "fleetx_meta.json"), "w") as f:
+        f.write('{"step"')
+    import jax
+    abstract = {"a": jax.ShapeDtypeStruct((4,), np.float32)}
+    with pytest.raises(RuntimeError, match="unreadable/corrupt"):
+        ckpt_lib.load_checkpoint(out, 1, abstract)
+
+
+def test_watchdog_detects_stall_once_per_episode():
+    reg = MetricsRegistry()
+    # pin the median step time via the registry (the engine records it per
+    # logging window) so the watchdog's own beat intervals — which include
+    # the injected stalls — don't inflate the threshold
+    reg.histogram("step_time").record(0.01)
+    flushed = []
+    wd = StepWatchdog(stall_factor=2.0, min_timeout_s=0.05, poll_s=0.01,
+                      action="log", on_stall=lambda: flushed.append(1),
+                      registry=reg)
+    wd.start()
+    try:
+        wd.beat(1)
+        time.sleep(0.4)  # no beats: one stall episode, fired exactly once
+        assert reg.counter("watchdog_stalls").value == 1
+        assert flushed == [1]
+        wd.beat(2)  # progress re-arms
+        time.sleep(0.4)
+        assert reg.counter("watchdog_stalls").value == 2
+    finally:
+        wd.stop()
+
+
+def test_watchdog_quiet_within_timeout():
+    reg = MetricsRegistry()
+    wd = StepWatchdog(stall_factor=10.0, min_timeout_s=60.0, poll_s=0.01,
+                      registry=reg)
+    wd.start()
+    try:
+        wd.beat(1)
+        time.sleep(0.1)
+        assert reg.counter("watchdog_stalls").value == 0
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# guard policy units
+# ---------------------------------------------------------------------------
+
+def test_guard_streak_and_actions():
+    reg = MetricsRegistry()
+    g = TrainingGuard(nonfinite_action="rollback", nonfinite_streak=2,
+                      max_rollbacks=1, registry=reg)
+    assert g.observe(1, float("nan")) is None  # streak 1
+    assert g.observe(2, float("nan")) == "rollback"  # streak 2 trips
+    g.note_rollback()
+    assert g.observe(3, 1.0) is None  # healthy resets nothing further
+    assert g.observe(4, float("nan")) is None
+    assert g.observe(5, float("nan")) == "abort"  # budget spent
+    assert reg.counter("nonfinite_skips").value == 4
+
+
+def test_guard_skip_action_only_counts():
+    reg = MetricsRegistry()
+    g = TrainingGuard(nonfinite_action="skip", nonfinite_streak=2,
+                      registry=reg)
+    for i in range(5):
+        assert g.observe(i, float("nan")) is None
+    assert reg.counter("nonfinite_skips").value == 5
+
+
+def test_guard_spike_detector():
+    reg = MetricsRegistry()
+    g = TrainingGuard(spike_action="abort", spike_factor=2.0,
+                      spike_min_steps=2, spike_ewma_alpha=0.5, registry=reg)
+    assert g.observe(1, 1.0) is None
+    assert g.observe(2, 1.0) is None
+    assert g.observe(3, 1.0) is None  # warmed up, no spike
+    assert g.observe(4, 10.0) == "abort"
+    assert reg.counter("loss_spikes_total").value == 1
+
+
+def test_resilience_facade_inert_when_disabled():
+    res = Resilience({"enable": False, "watchdog": {"enable": True}})
+    assert not res.enabled and not res.auto_resume
+    assert res.guard is None and not res.guard_skip
+    assert res.preemption is None and not res.preempted
+    assert res.make_watchdog() is None
+    assert not res.faults.armed
+
+
+# ---------------------------------------------------------------------------
+# end-to-end fault matrix (the acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def test_sigterm_at_step_k_then_resume_matches_uninterrupted(tmp_path,
+                                                             devices8):
+    """Preemption-safe exit: SIGTERM'd at step 3 → graceful emergency
+    checkpoint + rc 0; the auto-resumed run reproduces the uninterrupted
+    CPU-mesh loss curve."""
+    out = str(tmp_path / "ckpt")
+    batches = make_batches(6, seed=21)
+    mesh = build_mesh({}, devices=devices8[:1])
+
+    cfg_ref = tiny_cfg()
+    cfg_ref["Engine"]["max_steps"] = 6
+    ref = build_engine(cfg_ref, mesh).fit(list(batches))
+
+    cfg_a = tiny_cfg()
+    cfg_a["Engine"]["max_steps"] = 6
+    cfg_a["Engine"]["save_load"] = {"output_dir": out}
+    cfg_a["Resilience"] = {"enable": True, "faults": {"sigterm_at": 3}}
+    eng_a = build_engine(cfg_a, mesh)
+    exits_before = _counter("preemption_exits")
+    with pytest.raises(SystemExit) as excinfo:
+        eng_a.fit(list(batches))
+    assert excinfo.value.code == 0  # clean stop, not a crash
+    assert _counter("preemption_exits") - exits_before == 1
+    assert latest_step(out) == 3
+    assert peek_meta(out)["consumed_samples"] == 3 * 8
+
+    cfg_b = tiny_cfg()
+    cfg_b["Engine"]["max_steps"] = 6
+    cfg_b["Engine"]["save_load"] = {"output_dir": out}
+    cfg_b["Resilience"] = {"enable": True}  # auto_resume finds latest_step
+    eng_b = build_engine(cfg_b, mesh)
+    part2 = eng_b.fit(list(batches[3:]))
+    assert eng_b.ckpt_dir == out  # auto-resume picked the checkpoint up
+    np.testing.assert_allclose(part2, ref[3:], rtol=1e-6, atol=1e-6)
+
+
+def test_injected_ckpt_write_failure_is_retried(tmp_path, devices8):
+    """One transient checkpoint-write failure is absorbed by the retry
+    policy with no operator intervention — the run completes and every
+    periodic checkpoint exists."""
+    out = str(tmp_path / "ckpt")
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = 4
+    cfg["Engine"]["save_load"] = {"output_dir": out, "save_steps": 2}
+    cfg["Resilience"] = {"enable": True,
+                         "retry": {"max_attempts": 3, "backoff_s": 0.0,
+                                   "jitter": 0.0},
+                         "faults": {"ckpt_write_fail_times": 1}}
+    eng = build_engine(cfg, mesh)
+    retries_before = _counter("ckpt_retries_total")
+    losses = eng.fit(make_batches(4, seed=3))
+    assert len(losses) == 4 and all(np.isfinite(losses))
+    assert _counter("ckpt_retries_total") - retries_before >= 1
+    assert completed_steps(out) == [2, 4]
+
+
+def test_nonfinite_streak_triggers_rollback_then_abort(tmp_path, devices8):
+    """NaN-poisoned batches (injected loss_mask NaNs flowing through the
+    real jitted step) trip the streak: restore last-good, rewind the data,
+    and abort once the rollback budget is spent on the same poison."""
+    out = str(tmp_path / "ckpt")
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = 8
+    cfg["Engine"]["save_load"] = {"output_dir": out, "save_steps": 2}
+    cfg["Resilience"] = {"enable": True,
+                         "guard": {"nonfinite_action": "rollback",
+                                   "nonfinite_streak": 2,
+                                   "max_rollbacks": 1},
+                         "faults": {"nan_loss_at": [2, 3]}}
+    eng = build_engine(cfg, mesh)
+    rollbacks_before = _counter("rollbacks_total")
+    skips_before = _counter("nonfinite_skips")
+    with pytest.raises(TrainingAborted):
+        eng.fit(make_batches(8, seed=4))
+    assert _counter("rollbacks_total") - rollbacks_before == 1
+    assert _counter("nonfinite_skips") - skips_before >= 2
+    # the state is parked at the last good checkpoint, not the poison
+    import jax
+    assert int(jax.device_get(eng.state.step)) == 2
+    assert latest_step(out) == 2
+
+
+def test_guard_skip_preserves_params_through_nan_batch(tmp_path, devices8):
+    """The in-step isfinite skip (now any-dtype, not fp16-only) drops a
+    single NaN update on-device: training sails past one poisoned batch and
+    the optimizer step counter does not advance for it."""
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = 4
+    cfg["Engine"]["save_load"] = {"output_dir": str(tmp_path / "out")}
+    cfg["Resilience"] = {"enable": True,
+                         "guard": {"nonfinite_action": "skip",
+                                   "nonfinite_streak": 100},
+                         "faults": {"nan_loss_at": [1]}}
+    eng = build_engine(cfg, mesh)
+    losses = eng.fit(make_batches(5, seed=6))
+    import jax
+    # 5 batches consumed, one skipped: the step counter ends at 4
+    assert int(jax.device_get(eng.state.step)) == 4
+    finite = [l for l in losses if np.isfinite(l)]
+    assert len(finite) >= 3 and all(np.isfinite(finite))
+
+
+def test_data_raise_propagates_and_restart_resumes(tmp_path, devices8):
+    """A dataloader failure kills the run (supervise.py territory); the
+    restarted engine auto-resumes from the last periodic checkpoint and
+    completes."""
+    out = str(tmp_path / "ckpt")
+    batches = make_batches(4, seed=8)
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = 4
+    cfg["Engine"]["save_load"] = {"output_dir": out, "save_steps": 1}
+    cfg["Resilience"] = {"enable": True, "faults": {"data_raise_at": 2}}
+    eng = build_engine(cfg, mesh)
+    with pytest.raises(InjectedFault):
+        eng.fit(list(batches))
+    assert latest_step(out) == 2
+
+    cfg2 = tiny_cfg()
+    cfg2["Engine"]["max_steps"] = 4
+    cfg2["Engine"]["save_load"] = {"output_dir": out, "save_steps": 1}
+    cfg2["Resilience"] = {"enable": True}
+    eng2 = build_engine(cfg2, mesh)
+    part2 = eng2.fit(list(batches[2:]))
+    assert len(part2) == 2 and all(np.isfinite(part2))
+    import jax
+    assert int(jax.device_get(eng2.state.step)) == 4
+
+
+def test_watchdog_runs_quietly_through_a_fit(tmp_path, devices8):
+    """Engine-integrated watchdog smoke: thread starts/stops with fit and a
+    healthy run records zero stalls."""
+    mesh = build_mesh({}, devices=devices8[:1])
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = 3
+    cfg["Engine"]["save_load"] = {"output_dir": str(tmp_path / "out")}
+    cfg["Resilience"] = {"enable": True,
+                         "watchdog": {"enable": True, "min_timeout_s": 120.0,
+                                      "poll_s": 0.05}}
+    eng = build_engine(cfg, mesh)
+    stalls_before = _counter("watchdog_stalls")
+    losses = eng.fit(make_batches(3, seed=9))
+    assert len(losses) == 3
+    assert _counter("watchdog_stalls") == stalls_before
+    import threading
+    assert not any(t.name == "fleetx-watchdog" for t in threading.enumerate())
+
+
+def test_resilience_config_block_defaults():
+    from fleetx_tpu.utils.config import (AttrDict,
+                                         process_resilience_config)
+
+    cfg = process_resilience_config(AttrDict())
+    assert cfg["Resilience"]["enable"] is False
