@@ -43,6 +43,11 @@ DEFAULT_BATCH, DEFAULT_SEQ = 8, 1024
 BATCH = int(os.environ.get("FLEETX_BENCH_BS", DEFAULT_BATCH))
 SEQ = int(os.environ.get("FLEETX_BENCH_SEQ", DEFAULT_SEQ))
 VOCAB_CHUNK = int(os.environ.get("FLEETX_BENCH_VOCAB_CHUNK", 0))
+# ZeRO sharding stage for the bench mesh (docs/zero_sharding.md): 2 turns
+# on grad reduce-scatter + sharded update over an all-fsdp mesh; 0 keeps
+# the plain data-parallel step. Single-device runs exercise the code path
+# with fsdp=1 (constraints become no-ops).
+ZERO_STAGE = int(os.environ.get("FLEETX_BENCH_ZERO_STAGE", 0))
 HIDDEN, LAYERS, VOCAB = 1024, 24, 50304
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -182,6 +187,11 @@ def _bench_impl() -> dict:
                        "watchdog": {"enable": True, "min_timeout_s": 300.0,
                                     "action": "log"}},
     }
+    if ZERO_STAGE:
+        cfg["Distributed"] = {
+            "dp_degree": 1, "fsdp_degree": jax.device_count(),
+            "sharding": {"sharding_stage": ZERO_STAGE,
+                         "sharding_degree": jax.device_count()}}
     module = GPTModule(cfg)
     lr = build_lr_scheduler({"max_lr": 3e-4, "warmup_steps": 100,
                              "decay_steps": 1000})
@@ -241,7 +251,17 @@ def _bench_impl() -> dict:
         fit_wall = time.perf_counter() - t0
         stall_frac = ((engine.obs.stall_seconds_total() - stall0)
                       / max(fit_wall, 1e-9))
-        for phase in ("data_fetch", "shard_batch", "shard_batch_async"):
+        # isolated update-phase timing (docs/zero_sharding.md): norm + clip
+        # + optimizer + apply through the SAME closure train_step uses,
+        # recorded as the optimizer_update span the loop below picks up.
+        # Own try: a compile failure here must not discard the fit spans
+        # already recorded above (PR-3 phase-isolation stance).
+        try:
+            engine.measure_update_phase()
+        except Exception as e:
+            fit_error = f"measure_update_phase: {type(e).__name__}: {e}"[:200]
+        for phase in ("data_fetch", "shard_batch", "shard_batch_async",
+                      "optimizer_update"):
             summ = engine.obs.registry.histogram(phase).summary()
             if summ.get("count"):
                 span_means_ms[phase] = round(summ["mean"] * 1000.0, 3)
@@ -274,6 +294,12 @@ def _bench_impl() -> dict:
         "span_means_ms": span_means_ms,
         "prefetch_depth": prefetch_depth,
         "fit_step_time_s": round(fit_wall / n_steps, 4),
+        # ZeRO-2 evidence (docs/zero_sharding.md): bytes of grad leaves the
+        # stage-2 constraint distributes over fsdp (0 below stage 2 or on a
+        # 1-device mesh), next to the stage the mesh ran
+        "zero_stage": engine.sharding_stage,
+        "grad_bytes_sharded": int(
+            engine.obs.registry.gauge("grad_bytes_sharded").value or 0),
         # resilience counters (docs/resilience.md): all-zero on a healthy
         # run; fit_step_time_s vs step_time_s bounds the guard/watchdog
         # overhead since both run the same compiled step

@@ -73,6 +73,13 @@ class GPTConfig:
     # stay inert instead of quantising the forward for no saving
     # (_residual_casts_active).
     remat_save_dtype: Any = None
+    # dtype of the gradient-accumulation scan carry (docs/zero_sharding.md):
+    # fp32 (default) accumulates microbatch grads in full precision
+    # regardless of the compute dtype; bfloat16 opt-in halves the
+    # accumulator bytes that stay live across the whole step — under ZeRO-2
+    # the carry is additionally fsdp-sharded. None (YAML: "native") keeps
+    # the grads' native dtype (legacy behaviour).
+    grad_accum_dtype: Any = jnp.float32
     use_flash_attention: bool = True
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
     sequence_parallel: bool = False
@@ -768,7 +775,13 @@ def config_from_dict(d: dict) -> GPTConfig:
     known = {f.name for f in dataclasses.fields(GPTConfig)}
     kwargs = {k: v for k, v in d.items() if k in known and v is not None}
     dtype_map = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}
-    for key in ("dtype", "param_dtype", "remat_save_dtype"):
+    if str(kwargs.get("grad_accum_dtype")).lower() == "native":
+        # an empty YAML leaf means "use the fp32 default" (None values are
+        # filtered above); the legacy accumulate-in-grad-dtype mode needs
+        # an explicit spelling that survives that filter
+        kwargs["grad_accum_dtype"] = None
+    for key in ("dtype", "param_dtype", "remat_save_dtype",
+                "grad_accum_dtype"):
         if isinstance(kwargs.get(key), str):
             kwargs[key] = dtype_map[kwargs[key]]
     return GPTConfig(**kwargs)

@@ -9,7 +9,14 @@ so the interesting parts are:
   ``bias`` or a norm layer get no decay (reference ``optimizer.py:100-105``);
 - global-norm clipping across the whole (possibly sharded) grad pytree —
   under pjit the norm reduction runs as XLA collectives over the mesh;
-- multi-precision Adam: f32 master moments even for bf16 params.
+- multi-precision Adam: f32 master moments even for bf16 params;
+- single-pass global norm (docs/zero_sharding.md): the norm is an O(params)
+  reduction on the step's critical path, and the stock
+  ``optax.clip_by_global_norm`` recomputes what the engine already measured
+  for the ``grad_norm`` metric.  ``clip_by_precomputed_norm`` accepts the
+  norm as an optax extra arg so the caller threads ONE reduction through
+  metric + clip; ``adamw(fused_clip=True)`` goes further and owns the norm
+  itself, returning ``(updates, opt_state, grad_norm)`` from ``update``.
 """
 
 from __future__ import annotations
@@ -46,36 +53,97 @@ def decay_mask(params: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, mask)
 
 
+def clip_by_precomputed_norm(max_norm: float) -> optax.GradientTransformationExtraArgs:
+    """``optax.clip_by_global_norm`` that can reuse a norm computed upstream.
+
+    The caller passes the already-reduced global norm as the ``grad_norm``
+    extra arg (``optax.chain`` forwards extra args to every member), so the
+    jitted step carries exactly ONE norm reduction shared by the
+    ``grad_norm`` metric and the clip.  Without the extra arg the norm is
+    computed here — standalone use keeps stock semantics.
+    """
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None, *, grad_norm=None, **extra):
+        """Clip by ``grad_norm`` when threaded in, else compute the norm."""
+        del params, extra
+        g_norm = optax.global_norm(updates) if grad_norm is None else grad_norm
+        # stock optax semantics: scale only when the norm exceeds the cap,
+        # propagating NaN norms into the updates (the engine's finite-guard
+        # then skips the step)
+        trigger = jnp.squeeze(g_norm < max_norm)
+
+        def clip_fn(t):
+            return jax.lax.select(
+                trigger, t, (t / g_norm.astype(t.dtype)) * max_norm)
+
+        return jax.tree.map(clip_fn, updates), state
+
+    return optax.GradientTransformationExtraArgs(init, update)
+
+
+class FusedClipOptimizer:
+    """Update path that owns the global norm: ``update`` computes it once,
+    clips with it, and returns it — ``(updates, opt_state, grad_norm)``.
+
+    Not an ``optax.GradientTransformation`` (the return arity differs);
+    the engine detects the ``fused_clip`` attribute and skips its own
+    ``optax.global_norm`` pass entirely.
+    """
+
+    fused_clip = True
+
+    def __init__(self, inner: optax.GradientTransformation):
+        self._inner = optax.with_extra_args_support(inner)
+
+    def init(self, params):
+        return self._inner.init(params)
+
+    def update(self, grads, opt_state, params=None):
+        """One norm reduction: clip with it, return it with the updates."""
+        grad_norm = optax.global_norm(grads)
+        updates, new_state = self._inner.update(
+            grads, opt_state, params, grad_norm=grad_norm)
+        return updates, new_state, grad_norm
+
+
 def adamw(learning_rate, *, beta1: float = 0.9, beta2: float = 0.999,
           epsilon: float = 1e-8, weight_decay: float = 0.01,
           grad_clip: float | None = 1.0,
-          multi_precision: bool = True) -> optax.GradientTransformation:
+          multi_precision: bool = True, fused_clip: bool = False):
     """AdamW + global-norm clip + name-based decay mask.
 
     The decay mask is computed lazily from the param tree at ``init`` time via
     ``optax.masked`` with a callable mask, so the same transformation works for
-    any model family.
+    any model family.  ``fused_clip=True`` returns a ``FusedClipOptimizer``
+    whose ``update`` is ``(updates, opt_state, grad_norm)`` — the single-pass
+    norm owned by the optimizer instead of threaded in by the caller.
     """
     chain = []
     if grad_clip is not None and grad_clip > 0:
-        chain.append(optax.clip_by_global_norm(grad_clip))
+        chain.append(clip_by_precomputed_norm(grad_clip))
     chain.append(optax.scale_by_adam(
         b1=beta1, b2=beta2, eps=epsilon,
         mu_dtype=jnp.float32 if multi_precision else None))
     if weight_decay:
         chain.append(optax.add_decayed_weights(weight_decay, mask=decay_mask))
     chain.append(optax.scale_by_learning_rate(learning_rate))
-    return optax.chain(*chain)
+    tx = optax.chain(*chain)
+    return FusedClipOptimizer(tx) if fused_clip else tx
 
 
 def sgd(learning_rate, *, momentum: float = 0.9,
-        grad_clip: float | None = None) -> optax.GradientTransformation:
+        grad_clip: float | None = None, fused_clip: bool = False):
     """Plain SGD with optional momentum (reference Momentum optimizer)."""
     chain = []
     if grad_clip is not None and grad_clip > 0:
-        chain.append(optax.clip_by_global_norm(grad_clip))
+        chain.append(clip_by_precomputed_norm(grad_clip))
     chain.append(optax.sgd(learning_rate, momentum=momentum))
-    return optax.chain(*chain)
+    tx = optax.chain(*chain)
+    return FusedClipOptimizer(tx) if fused_clip else tx
 
 
 OPTIMIZERS = {"FusedAdamW": adamw, "AdamW": adamw, "adamw": adamw,
@@ -95,8 +163,10 @@ def build_optimizer(cfg: dict, lr_schedule) -> optax.GradientTransformation:
         raise ValueError(f"unknown optimizer {name!r}")
     clip = cfg.get("grad_clip")
     clip_norm = None
+    fused = bool(cfg.get("fused_clip"))
     if isinstance(clip, dict):
         clip_norm = float(clip.get("clip_norm", 1.0))
+        fused = bool(clip.get("fused", fused))
     elif clip is not None:
         clip_norm = float(clip)
     if fn is adamw:
@@ -108,6 +178,7 @@ def build_optimizer(cfg: dict, lr_schedule) -> optax.GradientTransformation:
             weight_decay=float(cfg.get("weight_decay", 0.01)),
             grad_clip=clip_norm,
             multi_precision=bool(cfg.get("multi_precision", True)),
+            fused_clip=fused,
         )
     return sgd(lr_schedule, momentum=float(cfg.get("momentum", 0.9)),
-               grad_clip=clip_norm)
+               grad_clip=clip_norm, fused_clip=fused)

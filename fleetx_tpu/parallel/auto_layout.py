@@ -10,12 +10,13 @@ the other half the reference leaves to the user: choosing the degrees.
 ``suggest_layout`` picks ``(dp, fsdp, mp, pp, seq)`` for a model + device
 count from a first-order memory model and TPU cost preferences:
 
-- the memory model (``estimate_memory_terms``) is stage-aware: ZeRO
-  stage 1/2 shards only the Adam moments over ``fsdp`` (this engine keeps
-  the f32 params, grads and bf16 compute copy replicated at stage 2 —
-  ``parallel/sharding.zero_sharding``); stage 3 shards the weights too.
-  The planner starts at stage 2 and escalates to 3 when the replicated
-  weight bytes alone blow the budget;
+- the memory model (``estimate_memory_terms``) is stage-aware
+  (docs/zero_sharding.md): ZeRO stage 1 shards the Adam moments over
+  ``fsdp``; stage 2 additionally shards the f32 gradients / accumulation
+  carry (``parallel/sharding.zero_grad_specs`` — the engine constrains the
+  grad pytree in-step, so the grad bytes divide by ``fsdp`` too); stage 3
+  shards the weights as well.  The planner starts at stage 2 and escalates
+  to 3 when the replicated weight bytes alone blow the budget;
 - activations shard over mp/pp/seq but NOT fsdp, so when the activation
   term alone exceeds the budget the planner grows mp/pp before fsdp could
   burn the device budget without helping;
@@ -34,8 +35,9 @@ from __future__ import annotations
 
 from fleetx_tpu.utils.log import logger
 
-_MOMENT_BYTES_PER_PARAM = 8.0   # 2 × f32 Adam moments — fsdp shards at stage ≥ 1
-_WEIGHT_BYTES_PER_PARAM = 10.0  # f32 params + f32 grads + bf16 copy — stage 3 only
+_MOMENT_BYTES_PER_PARAM = 8.0  # 2 × f32 Adam moments — fsdp shards at stage ≥ 1
+_GRAD_BYTES_PER_PARAM = 4.0    # f32 grads / accum carry — fsdp shards at stage ≥ 2
+_WEIGHT_BYTES_PER_PARAM = 6.0  # f32 params + bf16 compute copy — stage 3 only
 # activations are modelled explicitly (estimate_memory_terms), so the
 # planning budget only reserves compiler workspace / fragmentation slack
 _HBM_BUDGET_FRACTION = 0.9
@@ -69,12 +71,14 @@ def estimate_memory_terms(model: dict, micro_batch: int = 1,
                           recompute: str | None = "dots") -> dict:
     """Unsharded per-term HBM bytes of one training step.
 
-    ``moments`` — the 2 f32 Adam moments (what ZeRO 1/2 shards and what
-    offload streams to host); ``weights`` — f32 params + f32 grads + the
-    bf16 compute copy (sharded only by mp/pp, and by fsdp at stage 3);
-    ``act`` — activations at the recompute granularity plus the LM-head
-    logits block (full ``[b, s, V]`` f32 + gradient unless
-    ``Model.vocab_chunk`` caps it at chunked blocks).
+    ``moments`` — the 2 f32 Adam moments (what ZeRO 1+ shards and what
+    offload streams to host); ``grads`` — the f32 gradient buffer /
+    accumulation carry (what stage 2 additionally shards over ``fsdp`` —
+    halved when ``Model.grad_accum_dtype`` is bfloat16); ``weights`` —
+    f32 params + the bf16 compute copy (sharded only by mp/pp, and by
+    fsdp at stage 3); ``act`` — activations at the recompute granularity
+    plus the LM-head logits block (full ``[b, s, V]`` f32 + gradient
+    unless ``Model.vocab_chunk`` caps it at chunked blocks).
     """
     n_params = float(estimate_params(model))
     h = int(model.get("hidden_size") or 1024)
@@ -88,7 +92,11 @@ def estimate_memory_terms(model: dict, micro_batch: int = 1,
             int(model.get("num_attention_heads") or 16)
     head_cols = int(model.get("vocab_chunk") or 0) or vocab
     act += 8.0 * micro_batch * seq * min(head_cols, vocab)  # logits f32 + grad
+    grad_bytes = _GRAD_BYTES_PER_PARAM
+    if str(model.get("grad_accum_dtype") or "") == "bfloat16":
+        grad_bytes /= 2.0  # bf16 accumulation carry (docs/zero_sharding.md)
     return {"moments": _MOMENT_BYTES_PER_PARAM * n_params,
+            "grads": grad_bytes * n_params,
             "weights": _WEIGHT_BYTES_PER_PARAM * n_params,
             "act": act}
 
@@ -104,8 +112,9 @@ def _per_device_bytes(terms: dict, fsdp: int, mp: int, pp: int, seq: int,
     """Shard the memory terms by what each ZeRO stage actually shards."""
     mpp = max(mp * pp, 1)
     moments = terms["moments"] / (mpp * (fsdp if stage >= 1 else 1))
+    grads = terms["grads"] / (mpp * (fsdp if stage >= 2 else 1))
     weights = terms["weights"] / (mpp * (fsdp if stage >= 3 else 1))
-    return moments + weights + terms["act"] / (mpp * max(seq, 1))
+    return moments + grads + weights + terms["act"] / (mpp * max(seq, 1))
 
 
 def advice_inputs(config: dict,
@@ -217,9 +226,9 @@ def suggest_layout(model: dict, n_devices: int, hbm_gb: float = 16.0,
 
     deg = plan(2)
     if not deg["_fits"]:
-        # stage 2 keeps the f32 params/grads replicated
-        # (parallel/sharding.zero_sharding); escalate to full param
-        # sharding and re-plan before giving up
+        # stage 2 shards moments + grads but keeps the f32 params/bf16
+        # copy replicated (parallel/sharding.zero_grad_specs); escalate to
+        # full param sharding and re-plan before giving up
         deg3 = plan(3)
         if deg3["_fits"] or deg3["fsdp"] > 1:
             deg = deg3

@@ -24,7 +24,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import flax.linen as nn
 
-__all__ = ["make_axis_rules", "logical_sharding", "zero_sharding", "shard_logical"]
+__all__ = ["make_axis_rules", "logical_sharding", "zero_sharding",
+           "zero_grad_specs", "shard_logical"]
 
 
 def make_axis_rules(dist_config: dict | None = None) -> tuple[tuple[str, Any], ...]:
@@ -115,3 +116,48 @@ def zero_sharding(tree: Any, mesh: Mesh, axis: str = "fsdp",
     if param_shardings is not None:
         return jax.tree.map(leaf_sharding, tree, param_shardings)
     return jax.tree.map(leaf_sharding, tree)
+
+
+def zero_grad_specs(tree: Any, mesh: Mesh, axis: str = "fsdp",
+                    param_shardings: Any = None) -> Any:
+    """ZeRO-2 *gradient* sharding over the ``fsdp`` axis (docs/zero_sharding.md).
+
+    Stage 2 of the reference's ``group_sharded_parallel`` (``level="os_g"``)
+    shards gradients as well as optimizer state.  Constraining the grad
+    pytree (and the grad-accumulation scan carry) to these shardings inside
+    the jitted step lets GSPMD lower the data-parallel grad sync to
+    reduce-scatter + sharded update + param allgather instead of a full
+    allreduce followed by a replicated update — the scheme of "Automatic
+    Cross-Replica Sharding of Weight Update in Data-Parallel Training"
+    (PAPERS.md).
+
+    Per leaf: keep the param's existing spec (tensor-parallel / stage-3
+    dims stay where they are) and additionally shard the first
+    still-replicated dimension divisible by the ``fsdp`` size.  Leaves with
+    no such dimension (scalars, tiny vectors) keep the param spec — GSPMD
+    falls back to the plain allreduce for those few bytes.
+    """
+    size = mesh.shape[axis]
+
+    def leaf_spec(leaf: Any, existing: Any = None) -> Any:
+        shape = getattr(leaf, "shape", ())
+        spec = list(getattr(existing, "spec", P())) if existing is not None \
+            else []
+        spec += [None] * (len(shape) - len(spec))
+        used = set()
+        for entry in spec:
+            for a in (entry if isinstance(entry, (tuple, list)) else (entry,)):
+                if a is not None:
+                    used.add(a)
+        if size > 1 and axis not in used:
+            for dim, d in enumerate(shape):
+                if spec[dim] is None and d % size == 0 and d >= size:
+                    spec[dim] = axis
+                    break
+        while spec and spec[-1] is None:  # canonical form, no trailing Nones
+            spec.pop()
+        return NamedSharding(mesh, P(*spec))
+
+    if param_shardings is not None:
+        return jax.tree.map(leaf_spec, tree, param_shardings)
+    return jax.tree.map(leaf_spec, tree)

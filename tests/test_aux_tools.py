@@ -104,9 +104,10 @@ def test_auto_layout_planner():
     assert d["dp_degree"] == 8 and product(d) == 8
 
     # 6.7B on 16 devices: ZeRO sharding, no mp/pp needed. The planner
-    # escalates to stage 3: THIS engine's stage 2 keeps the f32
-    # params+grads replicated (parallel/sharding.zero_sharding), and
-    # 10 B/param × 6.7B = 67GB can never fit a 32GB chip replicated
+    # escalates to stage 3: stage 2 shards moments+grads
+    # (parallel/sharding.zero_grad_specs, docs/zero_sharding.md) but keeps
+    # the f32 params + bf16 copy replicated, and 6 B/param × 6.7B = 40GB
+    # can never fit a 32GB chip replicated
     d = suggest_layout(gpt67b, 16, hbm_gb=32)
     assert d["fsdp_degree"] >= 8 and d["mp_degree"] == 1 and product(d) == 16
     assert d["sharding"]["sharding_stage"] == 3

@@ -357,6 +357,20 @@ def _capture_gpt_bf16res(state: dict) -> None:
                         "FLEETX_BENCH_REMAT_SAVE_DTYPE": "bfloat16"}, {})])
 
 
+def _capture_gpt_zero2(state: dict) -> None:
+    """ZeRO-2 update-path A/B (docs/zero_sharding.md): same config as
+    gpt_policyfix with FLEETX_BENCH_ZERO_STAGE=2 — the grad pytree (and any
+    accumulation carry) is constrained over fsdp so GSPMD reduce-scatters
+    the grad sync and shards the fused update. On the single-chip tunnel
+    fsdp=1 makes the constraint a layout no-op: the capture audits the
+    code-path overhead (expected ~0) and records the isolated
+    optimizer_update span mean + grad_bytes_sharded that the multi-chip
+    A/B reads against. Read against gpt_policyfix."""
+    _bench_sweep(state, "gpt_zero2",
+                 [("", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                        "FLEETX_BENCH_ZERO_STAGE": "2"}, {})])
+
+
 CAPTURES = [
     ("gpt", _capture_gpt),
     ("gpt_trace", _capture_gpt_trace),
@@ -368,6 +382,7 @@ CAPTURES = [
     ("gpt_policyfix", _capture_gpt_policyfix),
     ("gpt_unroll", _capture_gpt_unroll),
     ("gpt_bf16res", _capture_gpt_bf16res),
+    ("gpt_zero2", _capture_gpt_zero2),
     ("imagen", _capture_imagen),
 ]
 
