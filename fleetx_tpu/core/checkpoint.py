@@ -10,6 +10,24 @@ new run uses — resharding across different dp/tp/fsdp degrees is free.
 Saved payload per step: the full TrainState (params, optimizer state, step,
 dropout rng) + a JSON meta dict (consumed_samples, epoch, host rng state) so
 a resumed run continues the loss curve exactly.
+
+Multi-host commit protocol (docs/resilience.md): a checkpoint is complete
+only when EVERY process's shard writes are durable, so ``save_checkpoint``
+runs a two-phase commit — all ranks finish their state writes, a gang
+barrier (``resilience/coordination.py``) proves it, and only then is the
+meta completion marker published. Two storage modes share the protocol:
+
+- shared storage (the TPU-pod default): Orbax global arrays, rank 0 alone
+  writes the meta/gc/rmtree side (the existing gating);
+- per-rank directories (``set_per_rank_mode``; host-local SSDs and the
+  multi-process CPU-mesh test gang, where XLA has no cross-process
+  computations and Orbax's multihost sync therefore cannot run): each rank
+  owns its directory via a host-local npz codec and writes its own meta —
+  still only after the gang barrier, so no rank's directory can claim a
+  step its peers never finished.
+
+Restore dispatches on the on-disk layout, so either mode's checkpoints
+load anywhere.
 """
 
 from __future__ import annotations
@@ -22,9 +40,11 @@ from typing import Any, Optional
 import time
 
 import jax
+import numpy as np
 
 from fleetx_tpu.observability.metrics import get_registry
 from fleetx_tpu.observability.trace import span
+from fleetx_tpu.resilience import coordination
 from fleetx_tpu.resilience import faults as faults_mod
 from fleetx_tpu.resilience.policy import call_with_retry
 from fleetx_tpu.utils.log import logger
@@ -35,8 +55,51 @@ except ImportError:  # pragma: no cover
     ocp = None
 
 _META_NAME = "fleetx_meta.json"
+#: host-local codec marker: a step dir carrying this file was written in
+#: per-rank mode and restores through the npz path on any topology
+_LOCAL_STATE = "state.npz"
 _checkpointer = None
 _pending: list[tuple[str, dict]] = []
+_per_rank = False
+
+
+_gang_commit = True
+
+
+def set_gang_commit(on: bool) -> None:
+    """Whether checkpoint completion requires the gang agreement (the
+    two-phase commit barrier / abandon vote). Engine-scoped global like
+    the fault plan; the engine DISABLES it when the resilience runtime is
+    off: without the runtime's voted loop exits, ranks can leave ``fit``
+    at different times, and an unmatched barrier would wedge a healthy
+    rank's save for the full agreement deadline."""
+    global _gang_commit
+    _gang_commit = bool(on)
+
+
+def set_per_rank_mode(on: bool) -> None:
+    """Select the per-rank-directory storage mode (engine-scoped global,
+    newest engine wins — same convention as the fault plan).
+
+    In this mode each process owns its checkpoint directory outright: the
+    state payload is a host-local npz snapshot (Orbax's multihost
+    machinery assumes one shared directory and hardcodes process 0 as the
+    numpy writer) and every rank publishes its own meta. The gang barrier
+    in ``save_checkpoint`` still gates completion on ALL ranks' writes.
+    """
+    global _per_rank
+    _per_rank = bool(on)
+
+
+def per_rank_mode() -> bool:
+    """True when checkpoints are per-rank-directory host-local snapshots."""
+    return _per_rank
+
+
+def _is_meta_writer() -> bool:
+    """Whether THIS process publishes meta files / prunes directories:
+    rank 0 on shared storage, every rank for its own per-rank directory."""
+    return _per_rank or jax.process_index() == 0
 
 
 def _get_checkpointer():
@@ -65,6 +128,80 @@ def _tree_bytes(state: Any) -> int:
     return total
 
 
+def _atomic_write(target: str, write, mode: str = "w") -> None:
+    """Publish a file all-or-nothing: temp file + fsync + ``os.replace``,
+    with the temp removed on any failure so a crashed writer never leaves
+    a torn payload (or a truncated marker) behind the final name."""
+    tmp = f"{target}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            write(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def _save_state_local(path: str, state: Any) -> None:
+    """Per-rank codec: the whole state pytree as ONE atomic npz snapshot.
+
+    Leaves are host-fetched and written in flatten order; the treedef
+    lives in code (the engine rebuilds the same TrainState), mirroring the
+    unboxed-tree stance of the Orbax path. Temp-file + ``os.replace`` so a
+    mid-write crash never leaves a torn payload behind the meta marker.
+
+    Extension dtypes (``ml_dtypes`` bfloat16 & friends) don't survive the
+    npy format — they come back as raw void (``|V2``) — so the true dtype
+    names ride along in a ``__dtypes__`` entry and restore re-views the
+    raw bytes.
+    """
+    os.makedirs(path, exist_ok=True)
+    target = os.path.join(path, _LOCAL_STATE)
+    arrays = {f"leaf_{i}": np.asarray(leaf)
+              for i, leaf in enumerate(jax.tree.leaves(jax.device_get(state)))}
+    arrays["__dtypes__"] = np.array(
+        [str(arrays[f"leaf_{i}"].dtype) for i in range(len(arrays))])
+    _atomic_write(target, lambda f: np.savez(f, **arrays), mode="wb")
+
+
+def _restore_state_local(path: str, abstract_state: Any) -> Any:
+    """Load an npz snapshot into ``abstract_state``'s structure.
+
+    Leading-dim reshapes (the pipeline-layout adaptation of the Orbax
+    path) are applied whenever a stored leaf's element count matches the
+    requested shape; a genuine mismatch fails loudly with the leaf index.
+    """
+    leaves, treedef = jax.tree.flatten(abstract_state)
+    got = []
+    with np.load(os.path.join(path, _LOCAL_STATE)) as data:
+        dtypes = [str(d) for d in data["__dtypes__"]] \
+            if "__dtypes__" in data else None
+        for i, want in enumerate(leaves):
+            arr = data[f"leaf_{i}"]
+            if dtypes is not None and str(arr.dtype) != dtypes[i]:
+                # extension dtype flattened to raw void by the npy format
+                # (ml_dtypes bfloat16 → |V2): re-view the original dtype
+                arr = arr.view(np.dtype(dtypes[i]))
+            shape = tuple(getattr(want, "shape", arr.shape))
+            if tuple(arr.shape) != shape:
+                if arr.size != int(np.prod(shape)):
+                    raise ValueError(
+                        f"checkpoint leaf {i} has shape {arr.shape}, "
+                        f"requested {shape} — incompatible state structure")
+                arr = arr.reshape(shape)
+            want_dtype = getattr(want, "dtype", None)
+            if want_dtype is not None and arr.dtype != want_dtype:
+                # restore into the REQUESTED dtype like the Orbax path —
+                # resuming under a changed precision config must not
+                # silently keep training at the stored dtype
+                arr = arr.astype(want_dtype)
+            got.append(arr)
+    return jax.tree.unflatten(treedef, got)
+
+
 def save_checkpoint(directory: str, step: int, state: Any,
                     meta: Optional[dict] = None,
                     async_save: bool = False) -> str:
@@ -74,24 +211,33 @@ def save_checkpoint(directory: str, step: int, state: Any,
     preemption between the state write and the meta write); it is removed
     and overwritten rather than left to block every later save at this step.
 
+    Two-phase commit on multi-process gangs: after the state write, a gang
+    barrier proves EVERY rank's shards are durable before any meta marker
+    is published — without it, rank 0 could mark a step complete that a
+    slow peer never finished, and the next resume would restore a
+    half-existent checkpoint. Single-process runs pay nothing (the local
+    coordinator's barrier is a no-op).
+
     ``async_save``: return as soon as device arrays are snapshotted — disk
     I/O overlaps subsequent training steps. The meta file (the completion
     marker) is written by ``finalize_async_saves``, which callers invoke
     before the next save and at shutdown; an unfinalized save is simply a
-    half-written checkpoint the next run cleans up.
+    half-written checkpoint the next run cleans up. In per-rank mode the
+    npz snapshot is synchronous and cheap, so async degrades to sync.
     """
     finalize_async_saves()  # at most one outstanding async save
     path = os.path.abspath(_step_dir(directory, step))
-    if jax.process_index() == 0 and os.path.isdir(path) and \
+    if _is_meta_writer() and os.path.isdir(path) and \
             _read_meta(path) is None:
         # covers both the missing-meta (crash between state and meta
         # writes) and corrupt-meta (crash mid-json.dump before the write
-        # became atomic) shapes of a half-written save; rank-0 gated like
-        # _write_meta/gc_checkpoints — N hosts racing rmtree on shared
-        # storage crash each other with ENOENT/ENOTEMPTY
+        # became atomic) shapes of a half-written save; meta-writer gated
+        # like _write_meta/gc_checkpoints — N hosts racing rmtree on
+        # shared storage crash each other with ENOENT/ENOTEMPTY
         logger.info("removing half-written checkpoint: %s", path)
         shutil.rmtree(path)
-    ckptr = _get_checkpointer()
+    if _per_rank and async_save:
+        async_save = False
     reg = get_registry()
     t0 = time.perf_counter()
     retries = reg.counter("ckpt_retries_total")
@@ -100,6 +246,10 @@ def save_checkpoint(directory: str, step: int, state: Any,
         # injection point first so an injected transient failure exercises
         # the same retry path a real I/O blip would
         faults_mod.fire("ckpt_write")
+        if _per_rank:
+            _save_state_local(path, state)
+            return
+        ckptr = _get_checkpointer()
         ckptr.save(os.path.join(path, "state"), state, force=True)
         if not async_save:
             # orbax commits in the background even for "sync" callers: the
@@ -116,6 +266,10 @@ def save_checkpoint(directory: str, step: int, state: Any,
             _pending.append((path, full_meta))
             logger.info("async checkpoint started: %s", path)
         else:
+            # phase boundary: every rank's state is durable before ANY
+            # rank publishes a completion marker
+            if _gang_commit:
+                coordination.get_coordinator().barrier("ckpt_commit")
             call_with_retry(lambda: _write_meta(path, full_meta),
                             desc="checkpoint meta write", counter=retries)
             logger.info("saved checkpoint: %s", path)
@@ -137,19 +291,9 @@ def _write_meta(path: str, meta: dict) -> None:
     into the final name would leave a truncated marker that a resume
     counts as a complete checkpoint and then dies parsing.
     """
-    if jax.process_index() == 0:
-        target = os.path.join(path, _META_NAME)
-        tmp = f"{target}.tmp.{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(meta, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, target)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.remove(tmp)
-            raise
+    if _is_meta_writer():
+        _atomic_write(os.path.join(path, _META_NAME),
+                      lambda f: json.dump(meta, f))
 
 
 def _read_meta(path: str) -> Optional[dict]:
@@ -200,26 +344,51 @@ def finalize_async_saves() -> None:
     nothing else would reclaim the partial payload), and the loss is
     recorded loudly (``ckpt_failed_total`` + an error log) so a persistent
     storage problem is visible, not masked.
+
+    On a gang the abandon decision is itself COLLECTIVE: every rank votes
+    its local commit outcome into the ``ckpt_commit`` agreement (the
+    async form of the two-phase barrier), and ANY failure abandons the
+    save on ALL ranks — no rank may publish a completion marker for a
+    step a peer never committed, and because the failure path still
+    participates in the vote, the agreement generation counters stay in
+    lockstep (a rank that skipped the rendezvous would pair every later
+    commit barrier with the wrong save).
     """
     if not _pending:
         return
     reg = get_registry()
     retries = reg.counter("ckpt_retries_total")
     with span("ckpt_finalize"), reg.timer("ckpt_finalize"):
+        error: Optional[BaseException] = None
         try:
             _get_checkpointer().wait_until_finished()
         except Exception as e:  # noqa: BLE001 — abandoning, not crashing
+            error = e
+        # phase boundary of the async variant, fused with the failure
+        # vote: every rank's background commit must have drained before
+        # any completion marker appears anywhere
+        gang_failed = error is not None
+        if _gang_commit:
+            gang_failed = coordination.get_coordinator().any_flag(
+                "ckpt_commit", error is not None)
+        if gang_failed:
             abandoned = [p for p, _ in _pending]
             _pending.clear()
             reg.counter("ckpt_failed_total").inc(len(abandoned))
-            logger.error(
-                "async checkpoint commit FAILED (%s: %s) — abandoning %s; "
-                "training continues, the next periodic save retries from "
-                "scratch", type(e).__name__, e, abandoned)
+            if error is not None:
+                logger.error(
+                    "async checkpoint commit FAILED (%s: %s) — abandoning "
+                    "%s; training continues, the next periodic save retries "
+                    "from scratch", type(error).__name__, error, abandoned)
+            else:
+                logger.error(
+                    "async checkpoint commit failed on a PEER rank — "
+                    "abandoning %s here too (a checkpoint is complete only "
+                    "when every rank's shards are)", abandoned)
             # remove the half-written dirs NOW: periodic saves advance
             # monotonically and never revisit these steps, so nothing else
             # would ever reclaim the (potentially huge) partial payloads
-            if jax.process_index() == 0:
+            if _is_meta_writer():
                 for path in abandoned:
                     shutil.rmtree(path, ignore_errors=True)
             return
@@ -280,12 +449,12 @@ def gc_checkpoints(directory: str, keep_last: int,
     (periodic keep-forever archives). Half-written dirs are not touched —
     ``save_checkpoint`` owns those. Pruned dirs bump ``ckpt_gc_total``.
 
-    Rank-0 gated (same convention as ``_write_meta``): on multi-host
+    Meta-writer gated (same convention as ``_write_meta``): on multi-host
     fleets with shared checkpoint storage, N hosts racing ``rmtree`` on
     the same dirs would leave partially-deleted checkpoints that still
-    look complete.
+    look complete; in per-rank mode every host prunes its own directory.
     """
-    if jax.process_index() != 0:
+    if not _is_meta_writer():
         return 0
     steps = completed_steps(directory)
     if not steps:
@@ -341,8 +510,33 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
     pipeline layouts ``[L] / [S, L/S] / [V, S, L/(V*S)]`` — restore with the
     stored shape and reshape. The reference cannot restore across
     topologies at all (per-rank dirs must match, ``eager_engine.py:617-660``).
+
+    Dispatches on the on-disk layout: a ``state.npz`` payload (per-rank
+    mode) restores through the host-local codec — which applies the same
+    size-preserving reshapes — and an Orbax ``state/`` directory through
+    the sharded path, so checkpoints from either storage mode load on any
+    topology.
     """
     path = os.path.abspath(_step_dir(directory, step))
+    if os.path.exists(os.path.join(path, _LOCAL_STATE)):
+        reg = get_registry()
+        t0 = time.perf_counter()
+        with span("checkpoint_restore", step=int(step)):
+            state = call_with_retry(
+                lambda: _restore_state_local(path, abstract_state),
+                desc="checkpoint restore",
+                counter=reg.counter("ckpt_retries_total"))
+        reg.histogram("ckpt_restore").record(time.perf_counter() - t0)
+        reg.counter("ckpt_restores_total").inc()
+        reg.gauge("ckpt_bytes").set(_tree_bytes(state))
+        meta = _read_meta(path)
+        if meta is None:
+            raise RuntimeError(
+                f"checkpoint meta unreadable/corrupt for {path} — refusing "
+                f"to resume without step/consumed_samples")
+        logger.info("restored checkpoint: %s (step %d)", path,
+                    meta.get("step", step))
+        return state, meta
     ckptr = _get_checkpointer()
     request = abstract_state
     reshaped: list[str] = []

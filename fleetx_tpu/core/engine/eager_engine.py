@@ -42,7 +42,7 @@ from fleetx_tpu.observability.trace import ProfilerWindow
 from fleetx_tpu.parallel.mesh import build_mesh
 from fleetx_tpu.parallel.sharding import (make_axis_rules, zero_grad_specs,
                                           zero_sharding)
-from fleetx_tpu.resilience import Resilience, TrainingAborted
+from fleetx_tpu.resilience import Resilience, TrainingAborted, coordination
 from fleetx_tpu.utils.log import logger
 
 
@@ -138,6 +138,52 @@ class EagerEngine(BasicEngine):
         # Resilience block enables it
         self.resilience = Resilience(self.cfg.get("Resilience"))
 
+        # gang coordinator (docs/resilience.md multi-host section): the
+        # local no-op on single-process runs, KV-store agreement on pods —
+        # every recovery decision below routes through it
+        self.coord = coordination.get_coordinator()
+        # per-rank checkpoint directories (host-local SSDs / CPU-mesh test
+        # gangs): each process owns <output_dir>/rank_<i> outright and the
+        # checkpoint layer switches to the host-local codec
+        self.per_rank_ckpt = bool(save_load.get("per_rank_dirs")) and \
+            self.coord.world > 1
+        if self.per_rank_ckpt:
+            suffix = f"rank_{self.coord.rank}"
+            self.output_dir = os.path.join(self.output_dir, suffix)
+            if self.ckpt_dir:
+                rank_dir = os.path.join(self.ckpt_dir, suffix)
+                if os.path.isdir(rank_dir):
+                    self.ckpt_dir = rank_dir
+                else:
+                    # warm start from a shared-layout checkpoint: restore
+                    # dispatches on the on-disk layout, so the un-suffixed
+                    # dir loads in per-rank mode too — rewriting it to a
+                    # nonexistent rank dir would silently skip the resume
+                    logger.warning(
+                        "per_rank_dirs: %s has no %s subdirectory — "
+                        "loading it as a shared-layout checkpoint",
+                        self.ckpt_dir, suffix)
+        if self.per_rank_ckpt and self.resilience.guard_skip:
+            # per-rank gangs save/restore each rank's OWN step counter:
+            # the in-step skip desynchronizes those counters, the saves
+            # then carry divergent step names, and resume refuses them —
+            # docs/resilience.md requires the skip off in this mode, so
+            # enforce it (guard rollback stays available and collective)
+            logger.warning(
+                "per_rank_dirs: disabling guard.skip_nonfinite_update — "
+                "the in-step skip desynchronizes per-rank step counters "
+                "and a divergent-step resume is refused; use the guard's "
+                "rollback action on per-rank gangs instead")
+            self.resilience.guard_skip = False
+            if self.resilience.guard is not None:
+                self.resilience.guard.skip_active = False
+        ckpt_lib.set_per_rank_mode(self.per_rank_ckpt)
+        # the two-phase commit needs the resilience runtime's VOTED loop
+        # exits: without them ranks can leave fit at different times and
+        # an unmatched commit barrier would wedge a healthy rank's save
+        ckpt_lib.set_gang_commit(self.resilience.enabled and
+                                 self.coord.world > 1)
+
         mp_cfg = dict(eng.get("mix_precision") or {})
         self.use_fp16_scaler = bool(mp_cfg.get("use_pure_fp16")) and (
             getattr(getattr(module, "model_cfg", None), "dtype", None) == jnp.float16)
@@ -145,6 +191,17 @@ class EagerEngine(BasicEngine):
 
         dist = dict(self.cfg.get("Distributed") or {})
         self.mesh = mesh if mesh is not None else build_mesh(dist)
+        if self.coord.world > 1 and not self.per_rank_ckpt and all(
+                d.process_index == jax.process_index()
+                for d in np.asarray(self.mesh.devices).flat):
+            # N processes with process-local meshes hold N independent
+            # states: Orbax's multihost sync cannot coordinate their saves
+            # into one shared directory (ranks would publish meta for
+            # divergent steps and silently lose peers' checkpoints)
+            raise ValueError(
+                "a multi-process run on a process-local mesh requires "
+                "Engine.save_load.per_rank_dirs: true — shared checkpoint "
+                "storage only composes with a mesh that spans processes")
         self.rules = make_axis_rules(dist)
         self.sharding_stage = int((dist.get("sharding") or {}).get("sharding_stage") or 0)
         self.sharding_offload = bool(
@@ -688,6 +745,14 @@ class EagerEngine(BasicEngine):
             if watchdog is not None:
                 watchdog.start()
                 cleanup.callback(watchdog.stop)
+            # distributed watchdog mode: a timed gang barrier every K steps
+            # whose timeout names the straggler ranks (None off-gang)
+            gang_wd = res.make_gang_watchdog(self.coord)
+            # collective loop control: with >1 process, a locally-observed
+            # event (a signal, a dry data stream) must NOT change control
+            # flow unilaterally — the peers would hang in their next
+            # collective; every exit happens on an agreed vote
+            gang_loop = res.enabled and self.coord.world > 1
 
             def wd_quiet():
                 """Suspend the stall detector around known-long host phases
@@ -721,13 +786,33 @@ class EagerEngine(BasicEngine):
             def restart_from_last_good():
                 """Guard rollback: restore the newest completed checkpoint,
                 rewind the data position, rebuild the input pipeline.
-                Returns the restored step."""
+                Returns the restored step.
+
+                Gang form: a barrier on entry (no rank starts restoring
+                while a peer is still dispatching the abandoned step), the
+                rollback step comes from a rank-0 broadcast (divergent
+                local views refuse loudly instead of restoring two
+                different steps), and a barrier on exit (no rank re-enters
+                the train loop before every peer finished restore+rewind).
+                """
+                self.coord.barrier("rollback_enter")
                 ckpt_lib.finalize_async_saves()
-                good = ckpt_lib.latest_step(self.output_dir)
+                good_local = ckpt_lib.latest_step(self.output_dir)
+                good = self.coord.broadcast("rollback_step", good_local)
                 if good is None:
                     raise TrainingAborted(
                         f"rollback requested at step {step} but no "
-                        f"completed checkpoint under {self.output_dir}")
+                        f"completed checkpoint under {self.output_dir}"
+                        + ("" if good_local is None else
+                           f" on rank 0 (this rank has step {good_local} — "
+                           f"divergent views, refusing a split rollback)"))
+                if good != good_local and \
+                        good not in ckpt_lib.completed_steps(self.output_dir):
+                    raise TrainingAborted(
+                        f"divergent checkpoint views at rollback: rank 0 "
+                        f"restores step {good} but this rank's "
+                        f"{self.output_dir} lacks it (local latest: "
+                        f"{good_local})")
                 # tear the whole input pipeline down BEFORE rewinding: the
                 # old DataLoader producer must be joined, or its last
                 # sampler advance could stomp the rewound consumed_samples
@@ -764,22 +849,111 @@ class EagerEngine(BasicEngine):
                 if res.guard is not None:
                     res.guard.note_rollback()
                 logger.warning("rolled back to checkpoint step %d", restored)
+                # no rank re-enters the step loop until every peer has
+                # finished restore + rewind — an early rank would dispatch
+                # a step its peers' state hasn't reached yet
+                self.coord.barrier("rollback_exit")
                 return wrap_stream(bi), restored
 
+            def fetch_item():
+                """One batch from the active source (device prefetcher when
+                armed, else the host iterator) under the ``data_fetch``
+                span; ``None`` means this rank's stream ran dry. Reads the
+                enclosing ``prefetcher``/``batch_iter`` bindings so a
+                rollback's pipeline rebuild is picked up transparently."""
+                src = prefetcher if prefetcher is not None else batch_iter
+                with self.obs.timed_span("data_fetch"):
+                    return next(src, None)
+
             metrics: dict = {}
-            while step < self.max_steps:
-                res.faults.maybe_sigterm(step, start_step=start_step)
-                if res.preempted:
-                    preemption_exit()
-                if prefetcher is not None:
-                    with self.obs.timed_span("data_fetch"):
-                        item = next(prefetcher, None)
-                else:
-                    with self.obs.timed_span("data_fetch"):
-                        item = next(batch_iter, None)
-                if item is None:
-                    self._epoch = final_epoch[0]
+            vote_round = 0  # iteration counter for gang collectives: the
+            # loop ITERATION count is lockstep across ranks by construction,
+            # while `step` can diverge under the in-step non-finite skip
+            # (a skipped update doesn't advance one rank's counter) — a
+            # step-keyed modulo would desynchronize the gang's collectives
+            last_save_round = last_eval_round = 0
+            stream_done = False  # this rank's stream ran dry (gang mode:
+            # awaiting the agreed exit — never a unilateral break)
+            vote_every = res.preemption_sync_every
+            shared_mesh = gang_loop and any(
+                d.process_index != jax.process_index()
+                for d in np.asarray(self.mesh.devices).flat)
+            if gang_loop and (res.guard is not None or gang_wd is not None
+                              or shared_mesh):
+                # the guard's window vote and the gang watchdog's call
+                # counter stay lockstep only while every rank runs every
+                # iteration's full body — the control vote must then run
+                # every iteration so a rank's exhaustion is agreed BEFORE
+                # any same-iteration collective could diverge. A mesh that
+                # spans processes forces the same cadence: every train
+                # step is a cross-process computation there, so a locally
+                # dry rank idling between votes would strand its peers
+                # inside the collective
+                vote_every = 1
+            while True:
+                if gang_loop:
+                    # the max_steps exit must ALSO be agreed: a rank whose
+                    # step counter reaches the target an iteration ahead
+                    # of a lagging peer (in-step skip skew) must not
+                    # return unilaterally — it idles as "done" until the
+                    # gang votes the run over
+                    if step >= self.max_steps:
+                        stream_done = True
+                elif step >= self.max_steps:
                     break
+                res.faults.maybe_sigterm(step, start_step=start_step)
+                if gang_loop:
+                    # fetch BEFORE the control vote so stream exhaustion
+                    # is a flag in the SAME iteration's agreement — a rank
+                    # leaving the loop unilaterally would wedge every
+                    # later collective its peers issue. An agreed exit
+                    # discards any fetched-but-untrained batch, which is
+                    # safe: consumed_samples advances only on trained
+                    # steps, so a resume re-fetches it.
+                    item = None
+                    if not stream_done:
+                        item = fetch_item()
+                        if item is None:
+                            stream_done = True
+                            self._epoch = final_epoch[0]
+                    if vote_round % vote_every == 0:
+                        # ONE agreement per round carrying every
+                        # loop-control flag: any rank's SIGTERM latches
+                        # preemption everywhere (the gang emergency-saves
+                        # the same step); any rank's dry stream ends the
+                        # run everywhere
+                        flags = self.coord.all_gather(
+                            "loop_flags",
+                            {"preempt": bool(res.preempted),
+                             "done": stream_done}).values()
+                        if any(f["preempt"] for f in flags):
+                            if res.preemption is not None:
+                                res.preemption.latch()
+                            preemption_exit()
+                        if any(f["done"] for f in flags):
+                            break
+                    vote_round += 1
+                    if item is None:
+                        # locally dry between votes (sync_every > 1 with
+                        # guard/gang-watchdog off): idle in lockstep; the
+                        # vote_round-keyed save rendezvous below must
+                        # still be matched or the peers' save would wedge
+                        # in the two-phase commit barrier
+                        if self.save_steps and \
+                                vote_round % self.save_steps == 0 and \
+                                vote_round != last_save_round:
+                            last_save_round = vote_round
+                            last_save = step
+                            with wd_quiet():
+                                self.save()
+                        continue
+                else:
+                    if res.preempted:
+                        preemption_exit()
+                    item = fetch_item()
+                    if item is None:
+                        self._epoch = final_epoch[0]
+                        break
                 self._epoch, payload = item
                 self.profiler.maybe_start(step)
                 if prefetcher is not None:
@@ -802,6 +976,14 @@ class EagerEngine(BasicEngine):
                 step += 1
                 if watchdog is not None:
                     watchdog.beat(step)
+                if gang_wd is not None:
+                    # the gang barrier legitimately blocks for up to
+                    # gang_timeout_s waiting on a wedged peer — suspend
+                    # the LOCAL stall detector so it cannot kill this
+                    # healthy rank before the barrier's straggler census
+                    # (the whole point of the distributed mode) can fire
+                    with wd_quiet():
+                        gang_wd.check(step)
                 if window % self.logging_freq == 0:
                     # ONE device->host sync per logging window: fetch the
                     # whole metrics pytree at once and convert on the host,
@@ -832,6 +1014,14 @@ class EagerEngine(BasicEngine):
                         decision = res.guard.observe(
                             step, loss,
                             finite=None if fin is None else bool(fin))
+                        if self.coord.world > 1:
+                            # collective verdict: any rank's NaN streak
+                            # rolls EVERYONE back, any abort aborts all —
+                            # no rank takes a recovery action its peers
+                            # don't mirror in the same window
+                            decision = coordination.most_severe(
+                                self.coord.all_gather(
+                                    "guard_decision", decision).values())
                         if decision == "rollback":
                             with wd_quiet():
                                 (batch_iter, prefetcher), step = \
@@ -855,14 +1045,40 @@ class EagerEngine(BasicEngine):
                 # profiler stop drains in-flight device work via the step's
                 # loss value so the trace tail isn't truncated
                 self.profiler.maybe_stop(step, sync=metrics.get("loss"))
-                if self.eval_freq and valid_data_loader is not None and \
-                        step % self.eval_freq == 0 and step != last_eval:
+                if self.eval_freq and valid_data_loader is not None:
+                    if gang_loop:
+                        # keyed on vote_round like the save trigger below
+                        # and for the same reason: eval is collective work
+                        # on a shared mesh, and a step-keyed trigger would
+                        # have a skip-lagged rank sit out an eval its
+                        # peers enter
+                        eval_due = vote_round % self.eval_freq == 0 and \
+                            vote_round != last_eval_round
+                    else:
+                        eval_due = step % self.eval_freq == 0 and \
+                            step != last_eval
+                else:
+                    eval_due = False
+                if eval_due:
                     last_eval = step
+                    last_eval_round = vote_round
                     with wd_quiet():
                         self.evaluate(valid_data_loader, global_step=step)
-                if self.save_steps and step % self.save_steps == 0 and \
-                        step != last_save:
+                if gang_loop:
+                    # keyed on the lockstep iteration counter, NOT `step`:
+                    # under the in-step non-finite skip one rank's step
+                    # counter can lag its peers', and a step-keyed trigger
+                    # would have that rank skip the save while everyone
+                    # else wedges in the two-phase commit barrier
+                    save_due = bool(self.save_steps) and \
+                        vote_round % self.save_steps == 0 and \
+                        vote_round != last_save_round
+                else:
+                    save_due = bool(self.save_steps) and \
+                        step % self.save_steps == 0 and step != last_save
+                if save_due:
                     last_save = step
+                    last_save_round = vote_round
                     with wd_quiet():
                         self.save()
                 if self._fault_step and start_step == 0 and \
@@ -1010,8 +1226,19 @@ class EagerEngine(BasicEngine):
         BEFORE the first batch is drawn so the data stream resumes at the
         checkpoint's exact sample position."""
         target = self.ckpt_dir or self.output_dir
-        meta_d = ckpt_lib.peek_meta(target) if target else None
+        local_meta = ckpt_lib.peek_meta(target) if target else None
+        # the resume decision is rank 0's: every host rewinds to the SAME
+        # consumed_samples/epoch regardless of what its own directory scan
+        # says — a host whose local view disagrees refuses loudly in
+        # load() rather than silently training from a different step
+        meta_d = self.coord.broadcast("resume_meta", local_meta)
         if not meta_d:
+            if local_meta:
+                raise RuntimeError(
+                    f"divergent checkpoint views: this rank sees step "
+                    f"{local_meta.get('step')} under {target} but rank 0 "
+                    f"found no completed checkpoint — refusing to resume "
+                    f"from two different steps")
             return
         self.ckpt_dir = target
         consumed = int(meta_d.get("consumed_samples", 0))
@@ -1038,13 +1265,35 @@ class EagerEngine(BasicEngine):
         layout (layer stacks ``[L]`` vs ``[S, L/S]`` vs ``[V, S, L/(V*S)]``)
         is adapted by reshaping leading dims — train with pp, eval without,
         or re-partition stages between runs.
+
+        Multi-host: the restore step comes from a rank-0 broadcast, never
+        from each host's own directory scan — hosts whose local view lacks
+        the agreed step refuse loudly (divergent storage is an operator
+        problem, not something to paper over with per-host guesses), and a
+        host with a NEWER local step defers to rank 0 with an error log.
         """
         ckpt_lib.finalize_async_saves()
         directory = directory or self.output_dir
-        step = ckpt_lib.latest_step(directory)
+        local = ckpt_lib.latest_step(directory)
+        step = self.coord.broadcast("resume_step", local)
         if step is None:
+            if local is not None:
+                raise RuntimeError(
+                    f"divergent checkpoint views: this rank has step "
+                    f"{local} under {directory} but rank 0 found no "
+                    f"completed checkpoint — refusing to resume from two "
+                    f"different steps")
             logger.info("no checkpoint found under %s", directory)
             return False
+        if step != local:
+            if step not in ckpt_lib.completed_steps(directory):
+                raise RuntimeError(
+                    f"divergent checkpoint views: rank 0 resumes step "
+                    f"{step} but this rank's {directory} lacks it (local "
+                    f"latest: {local})")
+            logger.error("divergent checkpoint views: local latest %s != "
+                         "rank-0 step %d — resuming from the rank-0 step",
+                         local, step)
         abstract = jax.tree.map(
             lambda s, x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             self.state_shardings, meta.unbox(jax.eval_shape(lambda: self.state)))

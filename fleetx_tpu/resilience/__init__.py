@@ -4,14 +4,18 @@ FleetX's value proposition is keeping thousand-chip runs alive; the
 reference delegates all fault handling to the Paddle substrate. This
 package owns it natively, one module per failure mode:
 
-- ``policy``     — retry/backoff-with-jitter + transient-vs-fatal
+- ``policy``       — retry/backoff-with-jitter + transient-vs-fatal
   classification (checkpoint I/O, downloads);
-- ``preemption`` — SIGTERM/SIGINT → graceful checkpoint-and-exit at the
+- ``preemption``   — SIGTERM/SIGINT → graceful checkpoint-and-exit at the
   next step boundary;
-- ``guard``      — non-finite-streak / loss-spike policy with
+- ``guard``        — non-finite-streak / loss-spike policy with
   ``skip | rollback | abort`` actions;
-- ``watchdog``   — hung-step heartbeat with stack dumps;
-- ``faults``     — deterministic fault injection driving the tests.
+- ``watchdog``     — hung-step heartbeat with stack dumps, plus the gang
+  barrier mode that names straggler ranks;
+- ``faults``       — deterministic fault injection driving the tests;
+- ``coordination`` — cross-process agreement primitives (timed barrier,
+  rank-0 broadcast, any-rank OR, majority vote) that turn each of the
+  above into a gang-wide decision on multi-host pods.
 
 ``Resilience`` is the engine-facing facade built from the ``Resilience:``
 YAML block (``utils/config.py``): with the block absent or disabled every
@@ -27,19 +31,23 @@ from __future__ import annotations
 from typing import Optional
 
 from fleetx_tpu.observability.metrics import get_registry
+from fleetx_tpu.resilience import coordination
 from fleetx_tpu.resilience import faults as faults_mod
+from fleetx_tpu.resilience.coordination import (  # noqa: F401
+    CoordinationTimeout, get_coordinator, most_severe)
 from fleetx_tpu.resilience.faults import FaultPlan, InjectedFault  # noqa: F401
 from fleetx_tpu.resilience.guard import (  # noqa: F401
     TrainingAborted, TrainingGuard)
 from fleetx_tpu.resilience.policy import (  # noqa: F401
     RetryPolicy, call_with_retry, is_transient, set_default_policy)
 from fleetx_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
-from fleetx_tpu.resilience.watchdog import StepWatchdog  # noqa: F401
+from fleetx_tpu.resilience.watchdog import GangWatchdog, StepWatchdog  # noqa: F401
 
 __all__ = [
     "Resilience", "RetryPolicy", "TrainingGuard", "TrainingAborted",
-    "PreemptionHandler", "StepWatchdog", "FaultPlan", "InjectedFault",
-    "call_with_retry", "is_transient", "set_default_policy",
+    "PreemptionHandler", "StepWatchdog", "GangWatchdog", "FaultPlan",
+    "InjectedFault", "CoordinationTimeout", "call_with_retry", "is_transient",
+    "set_default_policy", "get_coordinator", "most_severe",
 ]
 
 
@@ -76,14 +84,22 @@ class Resilience:
         self.preemption_exit_code = 0
         self.watchdog_enabled = False
         self._watchdog_cfg: dict = {}
+        self.preemption_sync_every = 1
         self.faults = FaultPlan()
         if not self.enabled:
             # inert AND isolating: a disabled engine must not inherit a
-            # previous engine's armed fault plan or tuned retry policy
-            # (the globals are engine-scoped; the newest engine wins)
+            # previous engine's armed fault plan, tuned retry policy or
+            # agreement deadlines (the globals are engine-scoped; the
+            # newest engine wins)
             faults_mod.install_plan(None)
             set_default_policy(None)
+            coordination.configure(None, None)
             return
+        # gang agreement deadlines (docs/resilience.md multi-host section):
+        # one knob pair shared by every collective the runtime issues
+        coord_cfg = dict(cfg.get("coordination") or {})
+        coordination.configure(coord_cfg.get("timeout_s"),
+                               coord_cfg.get("poll_s"))
         # the process-wide default policy: checkpoint.py / download.py
         # retry under the engine's Resilience.retry settings
         set_default_policy(self.retry_policy)
@@ -100,6 +116,10 @@ class Resilience:
             self.preemption = PreemptionHandler(pre_cfg.get("signals"))
         self.preemption_save = _on(pre_cfg.get("save_on_exit"))
         self.preemption_exit_code = int(pre_cfg.get("exit_code") or 0)
+        # steps between gang preemption votes (multi-process only): 1 means
+        # every step boundary is a legal gang-wide exit point
+        self.preemption_sync_every = max(int(pre_cfg.get("sync_every") or 1),
+                                         1)
         wd_cfg = dict(cfg.get("watchdog") or {})
         self.watchdog_enabled = bool(wd_cfg.get("enable"))
         self._watchdog_cfg = wd_cfg
@@ -118,4 +138,13 @@ class Resilience:
         if not (self.enabled and self.watchdog_enabled):
             return None
         return StepWatchdog.from_cfg(self._watchdog_cfg, on_stall=on_stall,
+                                     registry=self.registry)
+
+    def make_gang_watchdog(self, coord) -> Optional[GangWatchdog]:
+        """The distributed watchdog mode (timed gang barrier every K steps),
+        or None when the watchdog/gang mode is off or the gang has one
+        member. Independent of the heartbeat thread: a pod can run both."""
+        if not (self.enabled and self.watchdog_enabled):
+            return None
+        return GangWatchdog.from_cfg(self._watchdog_cfg, coord,
                                      registry=self.registry)

@@ -1,0 +1,356 @@
+"""Cross-process agreement primitives for multi-host gangs.
+
+PR 4's resilience runtime is process-local: the preemption latch reacts to
+the signal one rank happened to receive, the guard decides rollback from
+its own loss window, and resume scans a per-host directory. On a
+multi-process pod any of these lets ranks diverge and then hang inside the
+next collective — the failure mode the MPMD-pipeline scaling work
+(PAPERS.md) names as the blocker for DCN-linked multi-slice runs. This
+module gives every recovery decision a gang-wide form:
+
+- ``barrier(name)``        — timed rendezvous; a timeout reports *which
+  ranks arrived* (the straggler set a hung-collective post-mortem needs);
+- ``broadcast(name, v)``   — rank 0's JSON-serializable value to everyone
+  (resume-step agreement);
+- ``any_flag(name, f)``    — OR across ranks (one rank's SIGTERM latches
+  preemption everywhere);
+- ``all_gather(name, v)``  — every rank's value (guard decisions);
+- ``majority(name, v)``    — most common value, deterministic tie-break.
+
+Everything runs over the JAX distributed KV store
+(``jax._src.distributed.global_state.client``), NOT over device
+collectives: the KV store works wherever ``jax.distributed.initialize``
+does — including multi-process CPU meshes, where XLA has no cross-process
+computations and ``jax.experimental.multihost_utils`` therefore cannot run
+— and, unlike a device psum, it can time out and report who is missing.
+
+Calls are generation-counted per name: every rank must invoke the same
+primitives in the same order (they are collectives). A process-lifetime
+singleton (``get_coordinator``) keeps the generation counters monotonic
+across engine rebuilds so a fresh engine can never re-read a previous
+fit's stale keys.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import Counter, defaultdict
+from typing import Any, Dict, Iterable, Optional
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["CoordinationTimeout", "LocalCoordinator", "DistributedCoordinator",
+           "get_coordinator", "reset_coordinator", "configure",
+           "most_severe", "DEFAULT_TIMEOUT_S"]
+
+#: default agreement deadline — generous enough to ride out a checkpoint
+#: restore on the slowest rank, small enough that a wedged gang surfaces
+#: within one scheduler health-check interval
+DEFAULT_TIMEOUT_S = 600.0
+_DEFAULT_POLL_S = 0.05
+
+_timeout_s = DEFAULT_TIMEOUT_S
+_poll_s = _DEFAULT_POLL_S
+
+
+def configure(timeout_s: Optional[float] = None,
+              poll_s: Optional[float] = None) -> None:
+    """Set module-wide agreement defaults from ``Resilience.coordination``
+    (None resets a knob to its built-in default)."""
+    global _timeout_s, _poll_s
+    _timeout_s = DEFAULT_TIMEOUT_S if timeout_s is None else float(timeout_s)
+    _poll_s = _DEFAULT_POLL_S if poll_s is None else float(poll_s)
+
+
+class CoordinationTimeout(RuntimeError):
+    """An agreement deadline expired — carries the arrival census.
+
+    ``arrived``/``missing`` are the rank sets observed at expiry: the
+    missing set IS the straggler/crash suspect list, which is exactly what
+    a hung-gang post-mortem needs and what a plain deadlocked device
+    collective can never produce.
+    """
+
+    def __init__(self, name: str, arrived: Iterable[int],
+                 missing: Iterable[int], timeout_s: float):
+        self.name = name
+        self.arrived = sorted(arrived)
+        self.missing = sorted(missing)
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"coordination '{name}' timed out after {timeout_s:.1f}s: "
+            f"arrived ranks {self.arrived}, missing ranks {self.missing}")
+
+
+def most_severe(decisions: Iterable[Optional[str]]) -> Optional[str]:
+    """Combine per-rank guard decisions into the gang's decision.
+
+    Severity: ``None`` (healthy/tolerated) < ``"rollback"`` < ``"abort"``
+    — any rank's rollback rolls everyone back, any abort aborts everyone,
+    so no rank ever takes a recovery action the others don't mirror.
+    """
+    rank = {None: 0, "rollback": 1, "abort": 2}
+    worst = None
+    for d in decisions:
+        if rank.get(d, 0) > rank.get(worst, 0):
+            worst = d
+    return worst
+
+
+class LocalCoordinator:
+    """Single-process no-op implementation of the coordinator protocol.
+
+    Keeps every call site unconditional: a single-host run (the common dev
+    case, and every existing test) pays nothing and behaves byte-identically
+    to the pre-coordination engine.
+    """
+
+    rank = 0
+    world = 1
+
+    def barrier(self, name: str, timeout_s: Optional[float] = None) -> None:
+        """Trivially satisfied with one process."""
+
+    def broadcast(self, name: str, value: Any = None,
+                  timeout_s: Optional[float] = None) -> Any:
+        """Rank 0 is the only rank: its value is the agreement."""
+        return value
+
+    def any_flag(self, name: str, flag: bool,
+                 timeout_s: Optional[float] = None) -> bool:
+        """OR over one rank."""
+        return bool(flag)
+
+    def all_gather(self, name: str, value: Any = None,
+                   timeout_s: Optional[float] = None) -> Dict[int, Any]:
+        """One-entry census."""
+        return {0: value}
+
+    def majority(self, name: str, value: Any = None,
+                 timeout_s: Optional[float] = None) -> Any:
+        """A one-vote election."""
+        return value
+
+
+class DistributedCoordinator:
+    """KV-store implementation over the JAX distributed client.
+
+    ``all_gather`` is the base primitive: every rank publishes
+    ``<ns>/<name>/<generation>/<rank>`` and blocks on each peer's key
+    (server-side blocking gets — a rendezvous costs the actual rank skew,
+    not a poll quantum) until all ``world`` ranks appear or the deadline
+    expires — expiry raises :class:`CoordinationTimeout` with the arrival
+    census.
+    Barrier/any_flag/majority derive from it. ``broadcast`` is the one
+    asymmetric call: rank 0 publishes, everyone else does a blocking get.
+
+    A rank deletes its *previous* generation's key when a new generation
+    of the same name completes: observing all ranks in generation ``g``
+    proves every rank finished ``g-1``, so the old keys are dead and the
+    KV store stays bounded over million-step runs.
+    """
+
+    def __init__(self, client, rank: int, world: int,
+                 namespace: str = "fleetx/coord",
+                 poll_s: Optional[float] = None):
+        assert world >= 1 and 0 <= rank < world, (rank, world)
+        self._client = client
+        self.rank = int(rank)
+        self.world = int(world)
+        self._ns = namespace.rstrip("/")
+        self._poll_s = poll_s
+        self._gen: Dict[str, int] = defaultdict(int)
+
+    # ------------------------------------------------------------- internals
+    def _prefix(self, name: str, gen: int) -> str:
+        return f"{self._ns}/{name}/{gen}"
+
+    def _deadline(self, timeout_s: Optional[float]) -> float:
+        return time.monotonic() + (_timeout_s if timeout_s is None
+                                   else float(timeout_s))
+
+    def _poll_interval(self) -> float:
+        return _poll_s if self._poll_s is None else self._poll_s
+
+    def _await_key(self, key: str, remaining_s: float) -> Optional[str]:
+        """Block until ``key`` exists (returning its payload) or
+        ``remaining_s`` elapses (returning ``None``).
+
+        Prefers the KV store's server-side blocking get — the wake-up is
+        push-driven, so a rendezvous costs the actual rank skew, not a
+        poll quantum (the preemption vote sits on the hot step path).
+        Falls back to polling at ``poll_s`` for clients without it.
+        """
+        blocking = getattr(self._client, "blocking_key_value_get", None)
+        if blocking is not None:
+            t0 = time.monotonic()
+            try:
+                return blocking(key, max(int(remaining_s * 1000), 1))
+            except Exception:  # noqa: BLE001 — DEADLINE_EXCEEDED variants
+                if time.monotonic() - t0 < remaining_s * 0.9:
+                    # returned well before the deadline: a local
+                    # client/RPC failure, not an expiry — re-raise rather
+                    # than reporting healthy peers as a straggler census
+                    raise
+                return None
+        deadline = time.monotonic() + remaining_s
+        prefix, _, rank = key.rpartition("/")
+        while time.monotonic() < deadline:
+            payload = self._arrived(prefix).get(int(rank))
+            if payload is not None:
+                return payload
+            time.sleep(self._poll_interval())
+        return None
+
+    def _arrived(self, prefix: str) -> Dict[int, str]:
+        """Ranks that have published under ``prefix`` → their payloads."""
+        try:
+            entries = self._client.key_value_dir_get(prefix)
+        except Exception:  # noqa: BLE001 — directory not created yet
+            return {}
+        out: Dict[int, str] = {}
+        for key, payload in entries:
+            tail = str(key).rsplit("/", 1)[-1]
+            if tail.isdigit():
+                out[int(tail)] = payload
+        return out
+
+    def _gc_previous(self, name: str, gen: int) -> None:
+        """Drop our own key from the completed previous generation."""
+        if gen <= 0:
+            return
+        try:
+            self._client.key_value_delete(
+                f"{self._prefix(name, gen - 1)}/{self.rank}")
+        except Exception:  # noqa: BLE001 — GC is best-effort
+            pass
+
+    # ------------------------------------------------------------ primitives
+    def all_gather(self, name: str, value: Any = None,
+                   timeout_s: Optional[float] = None) -> Dict[int, Any]:
+        """Every rank's ``value`` for this generation of ``name``.
+
+        Deterministic across ranks: each rank publishes exactly once per
+        generation, so all ranks decode the identical census.
+        """
+        gen = self._gen[name]
+        self._gen[name] += 1
+        prefix = self._prefix(name, gen)
+        self._client.key_value_set(f"{prefix}/{self.rank}",
+                                   json.dumps(value))
+        timeout = _timeout_s if timeout_s is None else float(timeout_s)
+        deadline = time.monotonic() + timeout
+        # the per-peer blocking gets already return every payload (own
+        # value is known locally) — a success needs no extra directory
+        # read, which matters on the once-per-step loop_flags vote
+        payloads = {self.rank: json.dumps(value)}
+        for peer in range(self.world):
+            if peer == self.rank:
+                continue
+            remaining = deadline - time.monotonic()
+            payload = (self._await_key(f"{prefix}/{peer}", remaining)
+                       if remaining > 0 else None)
+            if payload is None:
+                arrived = self._arrived(prefix)
+                missing = set(range(self.world)) - set(arrived)
+                raise CoordinationTimeout(f"{name}#{gen}", arrived, missing,
+                                          timeout)
+            payloads[peer] = payload
+        self._gc_previous(name, gen)
+        return {r: json.loads(p) for r, p in payloads.items()}
+
+    def barrier(self, name: str, timeout_s: Optional[float] = None) -> None:
+        """Timed rendezvous; :class:`CoordinationTimeout` names stragglers."""
+        self.all_gather(name, None, timeout_s=timeout_s)
+
+    def broadcast(self, name: str, value: Any = None,
+                  timeout_s: Optional[float] = None) -> Any:
+        """Rank 0's JSON-serializable ``value``, delivered to every rank."""
+        gen = self._gen[name]
+        self._gen[name] += 1
+        key = f"{self._prefix(name, gen)}/0"
+        if self.rank == 0:
+            self._client.key_value_set(key, json.dumps(value))
+            return value
+        timeout = _timeout_s if timeout_s is None else float(timeout_s)
+        payload = self._await_key(key, timeout)
+        if payload is None:
+            # the census is the set of PUBLISHED keys; a broadcast waiter
+            # never writes one, so it must not report itself as arrived
+            raise CoordinationTimeout(f"{name}#{gen}", [], [0], timeout)
+        return json.loads(payload)
+
+    def any_flag(self, name: str, flag: bool,
+                 timeout_s: Optional[float] = None) -> bool:
+        """True once ANY rank raised ``flag`` this generation."""
+        votes = self.all_gather(name, bool(flag), timeout_s=timeout_s)
+        return any(votes.values())
+
+    def majority(self, name: str, value: Any = None,
+                 timeout_s: Optional[float] = None) -> Any:
+        """The most common value; ties break toward the lowest-rank holder
+        so every rank resolves the same winner."""
+        votes = self.all_gather(name, value, timeout_s=timeout_s)
+        counts = Counter(json.dumps(v, sort_keys=True)
+                         for v in votes.values())
+        best = max(counts.items(),
+                   key=lambda kv: (kv[1], -self._first_holder(votes, kv[0])))
+        return json.loads(best[0])
+
+    @staticmethod
+    def _first_holder(votes: Dict[int, Any], encoded: str) -> int:
+        """Lowest rank holding ``encoded`` (tie-break anchor)."""
+        for rank in sorted(votes):
+            if json.dumps(votes[rank], sort_keys=True) == encoded:
+                return rank
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# Process-lifetime singleton
+# ---------------------------------------------------------------------------
+
+_coordinator = None
+
+
+def get_coordinator():
+    """The process-wide coordinator (built on first use).
+
+    Distributed iff ``jax.distributed`` is initialized with more than one
+    process at first call; otherwise the no-op local implementation. The
+    instance persists for the process lifetime so generation counters stay
+    monotonic across engine rebuilds — a fresh coordinator would restart
+    at generation 0 and re-read a previous fit's stale keys.
+    """
+    global _coordinator
+    if _coordinator is not None:
+        return _coordinator
+    client = None
+    world = 1
+    rank = 0
+    try:
+        import jax
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is not None:
+            world = jax.process_count()
+            rank = jax.process_index()
+    except Exception:  # noqa: BLE001 — no jax / no distributed runtime
+        client = None
+    if client is not None and world > 1:
+        _coordinator = DistributedCoordinator(client, rank, world)
+        logger.info("gang coordinator: rank %d of %d (KV-store agreement)",
+                    rank, world)
+    else:
+        _coordinator = LocalCoordinator()
+    return _coordinator
+
+
+def reset_coordinator() -> None:
+    """Drop the singleton (tests only — a real process never outlives its
+    distributed runtime, and a fresh coordinator restarts generation
+    counters, which is unsafe while peers hold the old ones)."""
+    global _coordinator
+    _coordinator = None

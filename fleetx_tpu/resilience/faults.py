@@ -21,6 +21,12 @@ Plans come from the ``Resilience.faults`` config block or the
 nan_loss_at=4:5"``), env winning — so a restart harness can inject into an
 unmodified recipe. A module-level active plan lets deep layers
 (``core/checkpoint.py``) consult injection points without config plumbing.
+
+Multi-host gangs add ``only_rank: R``: the plan arms on process R alone
+and every other rank gets an empty plan from the same config — the drill a
+collective recovery needs is "ONE rank fails, the whole gang reacts"
+(one rank's SIGTERM, one rank's poisoned batch), which a uniformly-armed
+plan cannot stage.
 """
 
 from __future__ import annotations
@@ -42,6 +48,19 @@ ENV_VAR = "FLEETX_FAULTS"
 class InjectedFault(OSError):
     """Injected transient failure — an ``OSError`` so the retry policy
     classifies it exactly like the real I/O error it stands in for."""
+
+
+def _this_rank(override: Optional[int] = None) -> int:
+    """This process's gang rank (0 when jax / the distributed runtime is
+    absent, so single-process drills behave like rank 0)."""
+    if override is not None:
+        return int(override)
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — faults must import without jax
+        return 0
 
 
 def _parse_env(spec: str) -> dict:
@@ -74,12 +93,24 @@ class FaultPlan:
 
     @classmethod
     def from_cfg(cls, cfg: Optional[dict],
-                 env: Optional[str] = None) -> "FaultPlan":
-        """Merge the config block and the env spec (env wins per key)."""
+                 env: Optional[str] = None,
+                 rank: Optional[int] = None) -> "FaultPlan":
+        """Merge the config block and the env spec (env wins per key).
+
+        ``only_rank`` (config or env) arms the plan on that process index
+        alone: every other rank receives an empty plan, so ONE config can
+        stage a single-rank failure for a whole gang. ``rank`` overrides
+        the process-index lookup (tests).
+        """
         merged = dict(cfg or {})
         env = os.environ.get(ENV_VAR) if env is None else env
         if env:
             merged.update(_parse_env(env))
+        only = merged.get("only_rank")
+        if only is not None and int(only) != _this_rank(rank):
+            logger.info("fault plan targets rank %d only — disarmed on "
+                        "rank %d", int(only), _this_rank(rank))
+            return cls()
         nan_at = merged.get("nan_loss_at")
         if isinstance(nan_at, int):
             nan_at = [nan_at]

@@ -95,7 +95,18 @@ def get_default_policy() -> RetryPolicy:
 
 def is_transient(exc: BaseException,
                  policy: Optional[RetryPolicy] = None) -> bool:
-    """True when ``exc`` is worth retrying under ``policy``."""
+    """True when ``exc`` is worth retrying under ``policy``.
+
+    A :class:`~fleetx_tpu.resilience.coordination.CoordinationTimeout` is
+    categorically fatal — even under a custom policy with widened
+    ``transient_types`` — because an expired agreement deadline means the
+    GANG diverged: retrying one rank's call would advance it a generation
+    past its peers and convert a detectable straggler into a silent hang.
+    """
+    from fleetx_tpu.resilience.coordination import CoordinationTimeout
+
+    if isinstance(exc, CoordinationTimeout):
+        return False
     types = (policy or _active_policy).transient_types
     return isinstance(exc, types)
 

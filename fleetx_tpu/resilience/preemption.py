@@ -95,6 +95,20 @@ class PreemptionHandler:
                        "(signal again to force the default behaviour)",
                        signum)
 
+    def latch(self, reason: str = "gang agreement") -> None:
+        """Latch without a local signal — the gang propagation path.
+
+        When the preemption vote (``coordination.any_flag``) reports that
+        ANOTHER rank received SIGTERM, every rank latches locally so the
+        whole gang takes the same checkpoint-and-exit at the same step
+        boundary; the local latch also keeps the second-signal escalation
+        semantics intact if this rank later receives its own signal.
+        """
+        if not self._flag.is_set():
+            self._flag.set()
+            logger.warning("preemption latched via %s — checkpoint-and-exit "
+                           "at the next step boundary", reason)
+
     @property
     def triggered(self) -> bool:
         """True once any registered signal has been received."""

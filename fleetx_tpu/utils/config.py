@@ -257,9 +257,32 @@ def process_resilience_config(config: AttrDict) -> AttrDict:
     switch; per-knob defaults live in ONE place,
     ``resilience.Resilience`` and its component classes, which engines
     also reach without ``get_config``.
+
+    The multi-host knobs get eager validation here: a bad agreement
+    deadline or gang cadence would otherwise only surface as a hung or
+    divergent gang minutes into a pod run, the most expensive possible
+    place to learn about a YAML typo.
     """
     res = config.setdefault("Resilience", AttrDict())
     res.setdefault("enable", False)
+
+    def _positive(block: str, key: str, value) -> None:
+        if value is not None and float(value) <= 0:
+            raise ValueError(
+                f"Resilience.{block}.{key} must be > 0, got {value!r}")
+
+    coord = res.get("coordination") or {}
+    _positive("coordination", "timeout_s", coord.get("timeout_s"))
+    _positive("coordination", "poll_s", coord.get("poll_s"))
+    pre = res.get("preemption") or {}
+    _positive("preemption", "sync_every", pre.get("sync_every"))
+    wd = res.get("watchdog") or {}
+    _positive("watchdog", "gang_timeout_s", wd.get("gang_timeout_s"))
+    gang_steps = wd.get("gang_sync_steps")
+    if gang_steps is not None and int(gang_steps) < 0:
+        raise ValueError(
+            f"Resilience.watchdog.gang_sync_steps must be >= 0 "
+            f"(0 disables the gang barrier), got {gang_steps!r}")
     return config
 
 

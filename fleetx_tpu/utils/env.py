@@ -19,23 +19,39 @@ import jax
 
 from fleetx_tpu.utils.log import logger
 
-_initialized = False
+#: tri-state: None = never called, True/False = first call's verdict
+_initialized: bool | None = None
 
 
 def init_dist_env(coordinator_address: str | None = None,
                   num_processes: int | None = None,
-                  process_id: int | None = None) -> None:
+                  process_id: int | None = None) -> bool:
     """Initialize multi-host JAX if requested via env or args.
 
     Single-host (the common dev case) is a no-op: ``jax.devices()`` already
-    sees the local chips. Multi-host pods set ``FLEETX_COORDINATOR`` etc. or
-    rely on TPU metadata auto-detection inside ``jax.distributed.initialize``.
+    sees the local chips. Multi-host pods set ``FLEETX_COORDINATOR`` etc.
+    (``tools/supervise.py --num-procs`` populates exactly these) or rely on
+    TPU metadata auto-detection inside ``jax.distributed.initialize``.
+
+    Returns whether the distributed runtime is active after the call, and
+    is idempotent: re-entry (a second engine, a tool importing another
+    tool) returns the first call's verdict without re-initializing —
+    ``jax.distributed.initialize`` raises on double init.
+
+    Env parsing: ``FLEETX_NUM_PROCESSES`` unset/0 and ``FLEETX_PROCESS_ID``
+    unset both mean "let JAX auto-detect" (TPU metadata); explicit args
+    win over env.
     """
     global _initialized
-    if _initialized:
-        return
+    if _initialized is not None:
+        return _initialized
     coordinator_address = coordinator_address or os.environ.get("FLEETX_COORDINATOR")
-    if coordinator_address or os.environ.get("FLEETX_MULTIHOST"):
+    distributed = bool(coordinator_address
+                       or os.environ.get("FLEETX_MULTIHOST"))
+    if distributed:
+        # latch AFTER initialize returns: a raise (coordinator not up yet)
+        # must leave the verdict unset so the caller's retry can try again
+        # instead of silently running as a 1-process world
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes or int(os.environ.get("FLEETX_NUM_PROCESSES", 0)) or None,
@@ -44,7 +60,8 @@ def init_dist_env(coordinator_address: str | None = None,
         )
         logger.info("jax.distributed initialized: process %d/%d",
                     jax.process_index(), jax.process_count())
-    _initialized = True
+    _initialized = distributed
+    return _initialized
 
 
 def set_seed(seed: int) -> jax.Array:

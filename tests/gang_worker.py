@@ -1,0 +1,157 @@
+"""One member of a multi-process CPU-mesh resilience gang (not a test file).
+
+Launched N-at-a-time by ``tools/supervise.py --num-procs N`` from
+``tests/test_zz_multihost.py``: each process joins the gang via
+``jax.distributed.initialize`` (env populated by the supervisor, consumed
+by ``utils/env.py:init_dist_env``), trains the tiny ``test_engine`` GPT on
+its OWN single local CPU device (XLA has no cross-process computations on
+the CPU backend — every cross-rank decision therefore exercises the
+KV-store coordination layer, which is exactly what these tests probe), and
+writes a JSON status file the test asserts against: resume point, loss
+curve, final step, recovery counters, and how the run ended.
+
+Identical seeds + identical batches mean every rank's replica computes the
+identical loss curve, so single-rank fault injection
+(``FLEETX_FAULTS=...,only_rank=R``) makes any NON-collective recovery
+visibly diverge — the property the gang tests pin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+
+
+def _sanitize_env() -> None:
+    """Run on real local devices: strip the pytest conftest's forced
+    8-virtual-device flag (each gang member should see its own CPU) and
+    pin the CPU platform before JAX is imported."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    """Train (or probe-resume) one gang member; returns the exit code."""
+    parser = argparse.ArgumentParser(description="fleetx gang test worker")
+    parser.add_argument("--out", required=True,
+                        help="shared base output dir (per_rank_dirs appends "
+                             "rank_<i>)")
+    parser.add_argument("--status", required=True,
+                        help="status JSON path template with {rank}")
+    parser.add_argument("--steps", type=int, default=6)
+    parser.add_argument("--seed", type=int, default=21)
+    parser.add_argument("--save-steps", type=int, default=0)
+    parser.add_argument("--exit-code", type=int, default=75,
+                        help="Resilience.preemption.exit_code")
+    parser.add_argument("--faults", default="",
+                        help="FLEETX_FAULTS-style spec, e.g. "
+                             "'sigterm_at=3,only_rank=0'")
+    parser.add_argument("--guard-rollback", action="store_true",
+                        help="nonfinite_streak=2 -> rollback, budget 1, "
+                             "in-step skip OFF (keeps per-rank-replica "
+                             "step counters lockstep)")
+    parser.add_argument("--uneven", action="store_true",
+                        help="rank 1 gets one batch fewer (dry-stream "
+                             "exhaustion drill: the exit must be voted)")
+    args = parser.parse_args()
+
+    _sanitize_env()
+    if args.faults:
+        os.environ["FLEETX_FAULTS"] = args.faults
+
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, TESTS_DIR)
+    import jax
+
+    from fleetx_tpu.utils import env as env_mod
+
+    env_mod.init_dist_env()
+    rank = jax.process_index()
+
+    import fleetx_tpu.core.checkpoint as ckpt_lib
+    from fleetx_tpu.observability.metrics import get_registry
+    from fleetx_tpu.parallel.mesh import build_mesh
+    from fleetx_tpu.resilience import TrainingAborted
+    from test_engine import build_engine, make_batches, tiny_cfg
+
+    cfg = tiny_cfg()
+    cfg["Engine"]["max_steps"] = args.steps
+    cfg["Engine"]["save_load"] = {"output_dir": args.out,
+                                  "per_rank_dirs": True,
+                                  "save_steps": args.save_steps}
+    res_cfg = {
+        "enable": True,
+        "retry": {"max_attempts": 2, "backoff_s": 0.0, "jitter": 0.0},
+        "coordination": {"timeout_s": 120.0},
+        "preemption": {"enable": True, "save_on_exit": True,
+                       "exit_code": args.exit_code, "sync_every": 1},
+        "guard": {"enable": False},
+    }
+    if args.guard_rollback:
+        res_cfg["guard"] = {"enable": True, "nonfinite_action": "rollback",
+                            "nonfinite_streak": 2, "max_rollbacks": 1,
+                            "skip_nonfinite_update": False}
+    cfg["Resilience"] = res_cfg
+
+    mesh = build_mesh({}, devices=jax.local_devices()[:1])
+    eng = build_engine(cfg, mesh)
+    # the engine suffixed output_dir with rank_<i>; position the batch list
+    # at this rank's local resume point (the engine's rank-0 broadcast
+    # refuses loudly if that view diverges from the gang's). Clamp so a
+    # divergent LOCAL view (the fake-newer-step drill) cannot over-slice
+    # the stream before the engine even gets to rule on the divergence —
+    # fit draws one batch before restoring.
+    start = ckpt_lib.latest_step(eng.output_dir) or 0
+    start = min(start, args.steps - 1)
+    batches = make_batches(args.steps, seed=args.seed)
+    if args.uneven and rank == 1:
+        batches = batches[:-1]
+
+    stream = batches[start:]
+    if args.uneven:
+        # a ONE-SHOT iterator: a re-iterable list would wrap into the next
+        # epoch instead of running dry, and the drill needs a genuinely
+        # exhausted stream on one rank
+        stream = iter(stream)
+
+    status: dict = {"rank": int(rank), "resume_from": int(start)}
+    rc = 0
+    try:
+        losses = eng.fit(stream) or []
+        status["exit"] = "completed"
+        status["losses"] = [float(x) for x in losses]
+    except SystemExit as e:  # graceful preemption path
+        rc = int(e.code or 0)
+        status["exit"] = "preempted"
+        status["code"] = rc
+    except TrainingAborted as e:
+        rc = 3
+        status["exit"] = "aborted"
+        status["error"] = str(e)
+    except Exception as e:  # noqa: BLE001 — the status file is the report
+        rc = 4
+        status["exit"] = "error"
+        status["error"] = f"{type(e).__name__}: {e}"
+    if eng.state is not None:
+        status["final_step"] = int(jax.device_get(eng.state.step))
+    reg = get_registry()
+    status["rollbacks"] = reg.counter("rollbacks_total").value
+    status["preemption_exits"] = reg.counter("preemption_exits").value
+    status["ckpt_latest"] = ckpt_lib.latest_step(eng.output_dir)
+    path = args.status.format(rank=rank)
+    with open(f"{path}.tmp", "w") as f:
+        json.dump(status, f)
+    os.replace(f"{path}.tmp", path)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,52 +1,260 @@
-"""Restart supervisor — the ``paddle.distributed.launch`` elasticity analogue.
+"""Gang supervisor — the ``paddle.distributed.launch`` elasticity analogue.
 
 Reference runs inherit ``max_restart: 3`` from the launcher
 (``/root/reference/docs/quick_start.md:141``); this repo's recipes exec
 ``tools/train.py`` bare, so a crashed step killed the run even though
-checkpoint-resume works. This wrapper re-execs the training command until it
-exits cleanly, up to ``--max-restart`` times: each retry resumes from the
-last checkpoint (``Engine.save_load`` step/rng/consumed_samples restore —
-``core/checkpoint.py`` + ``tools/train.py``'s sampler wiring).
+checkpoint-resume works. This wrapper owns the full process lifecycle:
+
+- **launch**: ``--num-procs N`` starts N copies of the training command as
+  a JAX gang against a local coordinator (``FLEETX_COORDINATOR`` /
+  ``FLEETX_NUM_PROCESSES`` / ``FLEETX_PROCESS_ID``, consumed by
+  ``utils/env.py:init_dist_env``); N=1 is the classic single-process
+  restart wrapper. Every child gets its own process group.
+- **monitor + gang restart**: JAX gangs cannot shrink elastically — when
+  ANY member dies with a crash code, the survivors are gang-killed
+  (SIGTERM, grace wait, SIGKILL) and the WHOLE gang restarts with backoff,
+  up to ``--max-restart`` times; each retry resumes from the last
+  completed checkpoint (rank-0-broadcast agreement inside the engine).
+- **signal forwarding**: SIGTERM/SIGINT to the supervisor are forwarded to
+  every child process group and the supervisor WAITS — previously a
+  terminated supervisor orphaned the trainer mid-emergency-checkpoint.
+- **preemption awareness**: exit 0 and the ``--preemption-code`` are clean
+  stops, never restarted — a reclaimed TPU slice must not trigger a futile
+  crash-restart loop on a machine that is going away. Re-invoking the same
+  command later IS the gang restart: auto-resume picks up the emergency
+  checkpoint on every rank.
 
 Usage (what ``projects/*.sh`` invoke)::
 
-    python tools/supervise.py [--max-restart N] -- python tools/train.py -c cfg.yaml ...
+    python tools/supervise.py [--max-restart N] [--num-procs P] -- \
+        python tools/train.py -c cfg.yaml ...
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import signal
+import socket
 import subprocess
 import sys
 import time
 
+#: clean-preemption exit code the supervisor treats like rc 0 (override
+#: with --preemption-code; match it in Resilience.preemption.exit_code
+#: when you want a supervisor to distinguish preemption from success)
+PREEMPTION_EXIT_CODE = 75
+
+
+def _free_port() -> int:
+    """An OS-assigned free TCP port for the gang's local coordinator."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Gang:
+    """One generation of N child processes forming a JAX gang."""
+
+    def __init__(self, cmd: list, num_procs: int):
+        self.cmd = list(cmd)
+        self.num_procs = int(num_procs)
+        self.procs: list = []
+
+    def launch(self) -> None:
+        """Start all members; multi-process gangs get a fresh coordinator
+        address per generation (the previous service's port may linger in
+        TIME_WAIT after a gang kill)."""
+        env = dict(os.environ)
+        if self.num_procs > 1:
+            env["FLEETX_COORDINATOR"] = f"127.0.0.1:{_free_port()}"
+            env["FLEETX_NUM_PROCESSES"] = str(self.num_procs)
+        self.procs = []
+        for rank in range(self.num_procs):
+            child_env = dict(env)
+            if self.num_procs > 1:
+                child_env["FLEETX_PROCESS_ID"] = str(rank)
+            # own process group/session: signals forwarded with killpg
+            # reach the trainer AND anything it spawned (data workers)
+            self.procs.append(subprocess.Popen(self.cmd, env=child_env,
+                                               start_new_session=True))
+
+    def poll(self) -> dict:
+        """rank → returncode for members that have exited."""
+        return {i: p.returncode for i, p in enumerate(self.procs)
+                if p.poll() is not None}
+
+    def signal_all(self, sig: int) -> None:
+        """Deliver ``sig`` to every live member's process group."""
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+    def wait_all(self, timeout: float) -> bool:
+        """Wait up to ``timeout`` seconds for every member to exit."""
+        deadline = time.monotonic() + timeout
+        for p in self.procs:
+            remaining = deadline - time.monotonic()
+            try:
+                p.wait(timeout=max(remaining, 0.1))
+            except subprocess.TimeoutExpired:
+                return False
+        return True
+
+    def kill_all(self, grace: float) -> None:
+        """Gang kill: SIGTERM every member, grace wait, then SIGKILL."""
+        self.signal_all(signal.SIGTERM)
+        if not self.wait_all(grace):
+            print("[supervise] grace expired — SIGKILL to remaining gang "
+                  "members", file=sys.stderr)
+            self.signal_all(signal.SIGKILL)
+            self.wait_all(10.0)
+
+    def returncodes(self) -> list:
+        """Final returncodes (None for still-running members)."""
+        return [p.returncode for p in self.procs]
+
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description="fleetx restart supervisor")
+    """Supervisor entry point; returns the process exit code."""
+    parser = argparse.ArgumentParser(description="fleetx gang supervisor")
     parser.add_argument("--max-restart", type=int, default=3,
-                        help="restarts after a non-zero exit (reference "
+                        help="gang restarts after a crash (reference "
                              "launcher default: 3)")
     parser.add_argument("--backoff", type=float, default=5.0,
                         help="seconds to wait before a restart")
+    parser.add_argument("--num-procs", type=int, default=1,
+                        help="gang size: >1 launches a jax.distributed "
+                             "gang against a local coordinator")
+    parser.add_argument("--grace", type=float, default=30.0,
+                        help="seconds between gang SIGTERM and SIGKILL")
+    parser.add_argument("--preemption-code", type=int,
+                        default=PREEMPTION_EXIT_CODE,
+                        help="exit code treated as a clean preemption stop "
+                             "(never restarted); match "
+                             "Resilience.preemption.exit_code")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the training command")
     args = parser.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
     if not cmd:
         parser.error("no command given (expected: -- python tools/train.py ...)")
+    clean_codes = {0, args.preemption_code}
 
+    gang = Gang(cmd, args.num_procs)
+    forwarded = {"sig": None}
+
+    def _forward(signum, frame):
+        """Relay the operator's/scheduler's signal to the gang and let the
+        monitor loop wait for the graceful (emergency-checkpoint) exit."""
+        forwarded["sig"] = signum
+        # snapshot of who was visible at delivery: a member spawned
+        # mid-launch after this point never saw the signal, and _run must
+        # deliver to it exactly once (a SECOND signal to a member that
+        # already got one forces its immediate death, skipping the
+        # emergency checkpoint)
+        forwarded["signaled"] = list(gang.procs)
+        print(f"[supervise] forwarding signal {signum} to the gang",
+              file=sys.stderr)
+        gang.signal_all(signum)
+
+    previous = {s: signal.signal(s, _forward)
+                for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        rc = _run(gang, args, clean_codes, forwarded)
+    finally:
+        for s, h in previous.items():
+            signal.signal(s, h)
+    return rc
+
+
+def _shell_code(rc: int) -> int:
+    """Map a Popen returncode to a shell exit status (128+N for signals)
+    — ``sys.exit(-9)`` would otherwise truncate to 247, not 137."""
+    return 128 - rc if rc < 0 else rc
+
+
+def _run(gang: Gang, args, clean_codes: set, forwarded: dict) -> int:
+    """Launch/monitor/restart loop; returns the supervisor exit code."""
+    rc = 1
     for attempt in range(args.max_restart + 1):
         if attempt:
             print(f"[supervise] restart {attempt}/{args.max_restart} "
                   f"(resuming from last checkpoint) ...", file=sys.stderr)
             time.sleep(args.backoff)
-        rc = subprocess.call(cmd)
-        if rc == 0:
+        if forwarded["sig"] is not None:
+            # signal arrived before this generation launched (including
+            # DURING the backoff sleep — checking only at loop top raised
+            # a fresh gang on a machine that was just told to stop): the
+            # previous gang is already down, do not start another
+            return _shell_code(rc)
+        gang.launch()
+        if forwarded["sig"] is not None:
+            # landed while launch was mid-spawn: the handler signaled the
+            # members it could see at delivery; hand it to the rest
+            # exactly once (never re-signal — a second delivery forces
+            # immediate death, skipping the emergency checkpoint)
+            seen = forwarded.get("signaled") or []
+            for p in gang.procs:
+                if p not in seen and p.poll() is None:
+                    try:
+                        os.killpg(os.getpgid(p.pid), forwarded["sig"])
+                    except (ProcessLookupError, PermissionError):
+                        pass
+        crashed = None
+        while True:
+            exited = gang.poll()
+            if forwarded["sig"] is not None:
+                # a forwarded signal means the machine/operator wants us
+                # gone: wait for the graceful exits (the trainer is
+                # emergency-checkpointing), never restart
+                if not gang.wait_all(args.grace):
+                    gang.kill_all(args.grace)
+                rcs = gang.returncodes()
+                print(f"[supervise] gang stopped after signal "
+                      f"{forwarded['sig']} (rcs={rcs})", file=sys.stderr)
+                # a killed/crashed member must not be masked by a
+                # sibling's clean rc 0 — the outer scheduler needs to know
+                # an emergency checkpoint may be incomplete; negative rcs
+                # (signal kills) map to the shell's 128+N convention, and a
+                # member still alive after SIGKILL (returncode None — stuck
+                # in uninterruptible I/O) counts as SIGKILLed, not clean
+                bad = [r for r in rcs if r != 0]
+                crashed = [r for r in bad if r is None or r not in clean_codes]
+                if crashed:
+                    rc = next((r for r in crashed if r is not None), None)
+                    if rc is None:
+                        print("[supervise] gang member still running after "
+                              "SIGKILL — reporting failure", file=sys.stderr)
+                        rc = -signal.SIGKILL
+                else:
+                    rc = bad[0] if bad else 0
+                return _shell_code(rc)
+            crashed = next((r for r in exited.values()
+                            if r not in clean_codes), None)
+            if crashed is not None or len(exited) == gang.num_procs:
+                break
+            time.sleep(0.2)
+        if crashed is None:
+            rcs = gang.returncodes()
+            if any(r == args.preemption_code for r in rcs):
+                print(f"[supervise] gang preempted cleanly (rc="
+                      f"{args.preemption_code}) — not restarting; re-run "
+                      f"to resume from the emergency checkpoint",
+                      file=sys.stderr)
+                return args.preemption_code
             return 0
+        rc = crashed
         print(f"[supervise] command exited rc={rc}", file=sys.stderr)
+        # a JAX gang cannot shrink around a lost member: tear the whole
+        # generation down before the restart brings N fresh processes up
+        gang.kill_all(args.grace)
     print(f"[supervise] giving up after {args.max_restart} restarts",
           file=sys.stderr)
-    return rc
+    return _shell_code(rc)
 
 
 if __name__ == "__main__":
