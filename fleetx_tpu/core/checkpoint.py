@@ -28,6 +28,16 @@ meta completion marker published. Two storage modes share the protocol:
 
 Restore dispatches on the on-disk layout, so either mode's checkpoints
 load anywhere.
+
+Integrity (docs/resilience.md "Integrity"): every save publishes a
+``fleetx_integrity.json`` manifest (per-file crc32 digests of the payload,
+plus per-leaf digests where the full state is host-resident at save) next
+to the meta marker. Restore re-digests before trusting a byte and raises
+:class:`CheckpointIntegrityError` on any mismatch — the engine then falls
+back to the newest checkpoint that verifies. In per-rank mode the save
+READ-BACK verifies its own npz against the in-memory digests, and on
+gangs that outcome is each rank's vote in the ``ckpt_commit`` agreement:
+one corrupt shard aborts the commit on all ranks.
 """
 
 from __future__ import annotations
@@ -46,6 +56,9 @@ from fleetx_tpu.observability.metrics import get_registry
 from fleetx_tpu.observability.trace import span
 from fleetx_tpu.resilience import coordination
 from fleetx_tpu.resilience import faults as faults_mod
+from fleetx_tpu.resilience import integrity
+from fleetx_tpu.resilience.integrity import (CheckpointIntegrityError,
+                                             WriteVerifyError)
 from fleetx_tpu.resilience.policy import call_with_retry
 from fleetx_tpu.utils.log import logger
 
@@ -59,11 +72,61 @@ _META_NAME = "fleetx_meta.json"
 #: per-rank mode and restores through the npz path on any topology
 _LOCAL_STATE = "state.npz"
 _checkpointer = None
-_pending: list[tuple[str, dict]] = []
+_pending: list[tuple] = []
 _per_rank = False
 
 
 _gang_commit = True
+
+#: integrity manifests + restore verification (engine-scoped global like
+#: the fault plan; default ON — persisted state is never trusted blindly)
+_verify = True
+
+#: newest step per directory with verified evidence IN THIS PROCESS (a
+#: save whose read-back passed, or a restore whose digests matched) —
+#: retention GC never prunes it, so a fall-back target always survives
+_last_verified: dict[str, int] = {}
+
+
+def set_verify_mode(on: bool) -> None:
+    """Enable/disable integrity manifests and digest verification
+    (``Resilience.integrity.verify_checkpoints``; engine-scoped global,
+    newest engine wins — same convention as the fault plan)."""
+    global _verify
+    _verify = bool(on)
+
+
+def verify_mode() -> bool:
+    """True when manifests are written and restores verify digests."""
+    return _verify
+
+
+def _record_verified(directory: str, step: int) -> None:
+    """Note ``step`` as this process's newest verified step under
+    ``directory`` (monotonic; consumed by ``gc_checkpoints``)."""
+    key = os.path.abspath(directory)
+    if step >= _last_verified.get(key, -1):
+        _last_verified[key] = int(step)
+
+
+def _record_refused(directory: str, step: int) -> None:
+    """Demote a step that FAILED verification: a save-time "verified"
+    record is stale once the bytes rot on disk, and gc trusting it would
+    protect the corrupt step while pruning the good fall-back."""
+    key = os.path.abspath(directory)
+    if _last_verified.get(key) == int(step):
+        del _last_verified[key]
+
+
+def _record_refused_path(path: str) -> None:
+    """``_record_refused`` keyed by a ``step_<N>`` directory path."""
+    name = os.path.basename(os.path.abspath(path))
+    if name.startswith("step_"):
+        try:
+            _record_refused(os.path.dirname(os.path.abspath(path)),
+                            int(name[len("step_"):]))
+        except ValueError:
+            pass
 
 
 def set_gang_commit(on: bool) -> None:
@@ -128,30 +191,22 @@ def _tree_bytes(state: Any) -> int:
     return total
 
 
-def _atomic_write(target: str, write, mode: str = "w") -> None:
-    """Publish a file all-or-nothing: temp file + fsync + ``os.replace``,
-    with the temp removed on any failure so a crashed writer never leaves
-    a torn payload (or a truncated marker) behind the final name."""
-    tmp = f"{target}.tmp.{os.getpid()}"
-    try:
-        with open(tmp, mode) as f:
-            write(f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, target)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.remove(tmp)
-        raise
+#: the tmp+fsync+os.replace dance is ONE implementation, owned by the
+#: integrity module (its manifest writes share it with the state/meta
+#: writers here)
+_atomic_write = integrity.atomic_write
 
 
-def _save_state_local(path: str, state: Any) -> None:
+def _save_state_local(path: str, state: Any,
+                      host_leaves: Optional[list] = None) -> None:
     """Per-rank codec: the whole state pytree as ONE atomic npz snapshot.
 
     Leaves are host-fetched and written in flatten order; the treedef
     lives in code (the engine rebuilds the same TrainState), mirroring the
     unboxed-tree stance of the Orbax path. Temp-file + ``os.replace`` so a
     mid-write crash never leaves a torn payload behind the meta marker.
+    ``host_leaves`` reuses the host copies the caller already fetched for
+    digesting — one HBM→host transfer per save, not two.
 
     Extension dtypes (``ml_dtypes`` bfloat16 & friends) don't survive the
     npy format — they come back as raw void (``|V2``) — so the true dtype
@@ -160,27 +215,48 @@ def _save_state_local(path: str, state: Any) -> None:
     """
     os.makedirs(path, exist_ok=True)
     target = os.path.join(path, _LOCAL_STATE)
-    arrays = {f"leaf_{i}": np.asarray(leaf)
-              for i, leaf in enumerate(jax.tree.leaves(jax.device_get(state)))}
+    if host_leaves is None:
+        host_leaves = [np.asarray(leaf)
+                       for leaf in jax.tree.leaves(jax.device_get(state))]
+    arrays = {f"leaf_{i}": leaf for i, leaf in enumerate(host_leaves)}
     arrays["__dtypes__"] = np.array(
-        [str(arrays[f"leaf_{i}"].dtype) for i in range(len(arrays))])
+        [str(arrays[f"leaf_{i}"].dtype) for i in range(len(host_leaves))])
     _atomic_write(target, lambda f: np.savez(f, **arrays), mode="wb")
 
 
-def _restore_state_local(path: str, abstract_state: Any) -> Any:
+def _restore_state_local(path: str, abstract_state: Any,
+                         manifest: Optional[dict] = None) -> Any:
     """Load an npz snapshot into ``abstract_state``'s structure.
 
     Leading-dim reshapes (the pipeline-layout adaptation of the Orbax
     path) are applied whenever a stored leaf's element count matches the
     requested shape; a genuine mismatch fails loudly with the leaf index.
+
+    With a ``manifest`` carrying per-leaf digests, every leaf's RAW bytes
+    (before the extension-dtype re-view and any requested cast) are
+    verified against the digests computed at save —
+    :class:`CheckpointIntegrityError` on mismatch, never a silent
+    restore of corrupt values.
     """
     leaves, treedef = jax.tree.flatten(abstract_state)
+    leaf_digests = (manifest or {}).get("leaves") or []
     got = []
     with np.load(os.path.join(path, _LOCAL_STATE)) as data:
         dtypes = [str(d) for d in data["__dtypes__"]] \
             if "__dtypes__" in data else None
         for i, want in enumerate(leaves):
             arr = data[f"leaf_{i}"]
+            if i < len(leaf_digests):
+                digest = leaf_digests[i]
+                host = np.ascontiguousarray(arr)
+                if int(host.nbytes) != int(digest["nbytes"]) or \
+                        integrity.digest_bytes(host.tobytes()) != \
+                        int(digest["crc32"]):
+                    _record_refused_path(path)
+                    raise CheckpointIntegrityError(
+                        f"checkpoint leaf {i} of {path} does not match "
+                        f"its manifest digest — refusing to restore "
+                        f"corrupt state")
             if dtypes is not None and str(arr.dtype) != dtypes[i]:
                 # extension dtype flattened to raw void by the npy format
                 # (ml_dtypes bfloat16 → |V2): re-view the original dtype
@@ -224,6 +300,15 @@ def save_checkpoint(directory: str, step: int, state: Any,
     before the next save and at shutdown; an unfinalized save is simply a
     half-written checkpoint the next run cleans up. In per-rank mode the
     npz snapshot is synchronous and cheap, so async degrades to sync.
+
+    Integrity: the manifest (per-file digests + per-leaf digests where
+    the full state is host-resident at save) is published between the
+    commit agreement and the meta marker, so a manifest always describes
+    durable bytes. The per-rank codec additionally READ-BACK verifies its
+    just-written npz against the in-memory digests — a torn write retries
+    under the policy, a sticky one (dying disk, ``corrupt_ckpt_at``
+    drill) becomes this rank's FAILED ``ckpt_commit`` vote and aborts the
+    commit on every rank (no meta anywhere), or raises loudly off-gang.
     """
     finalize_async_saves()  # at most one outstanding async save
     path = os.path.abspath(_step_dir(directory, step))
@@ -241,38 +326,98 @@ def save_checkpoint(directory: str, step: int, state: Any,
     reg = get_registry()
     t0 = time.perf_counter()
     retries = reg.counter("ckpt_retries_total")
+    # per-leaf digests need the full state host-resident at save time:
+    # always true for the per-rank codec (whose host fetch is shared with
+    # the digest pass — ONE HBM→host transfer per save) and for
+    # single-process sync Orbax saves; a multi-process shared-Orbax host
+    # holds only its local shards (a device_get would gather peers'
+    # shards over the fabric) and an async save must not block on the
+    # fetch — those manifests are files-only
+    leaf_digests = None
+    host_leaves = None
+    if _verify and (_per_rank or
+                    (not async_save and jax.process_count() == 1)):
+        host_leaves = [np.asarray(leaf)
+                       for leaf in jax.tree.leaves(jax.device_get(state))]
+        leaf_digests = [integrity.digest_array(leaf)
+                        for leaf in host_leaves]
 
     def _write_state():
         # injection point first so an injected transient failure exercises
         # the same retry path a real I/O blip would
         faults_mod.fire("ckpt_write")
         if _per_rank:
-            _save_state_local(path, state)
-            return
-        ckptr = _get_checkpointer()
-        ckptr.save(os.path.join(path, "state"), state, force=True)
-        if not async_save:
-            # orbax commits in the background even for "sync" callers: the
-            # real disk error surfaces HERE, so the drain must live inside
-            # the retried fn — a failure re-dispatches the whole save
-            # (force=True overwrites the partial attempt)
-            ckptr.wait_until_finished()
+            _save_state_local(path, state, host_leaves=host_leaves)
+        else:
+            ckptr = _get_checkpointer()
+            ckptr.save(os.path.join(path, "state"), state, force=True)
+            if not async_save:
+                # orbax commits in the background even for "sync" callers:
+                # the real disk error surfaces HERE, so the drain must live
+                # inside the retried fn — a failure re-dispatches the whole
+                # save (force=True overwrites the partial attempt)
+                ckptr.wait_until_finished()
+        # corruption injection AFTER the write, BEFORE the read-back: the
+        # drill is a byte rotting between the write and its verification
+        faults_mod.fire_path("ckpt_written", path, int(step))
+        if _per_rank and leaf_digests is not None:
+            bad = integrity.verify_npz_leaves(path, leaf_digests)
+            reg.counter("ckpt_verify_total").inc()
+            if bad:
+                reg.counter("ckpt_verify_failed").inc()
+                raise WriteVerifyError(
+                    f"read-back verification of {path} failed: leaves "
+                    f"{bad} differ from the digests computed at save")
 
+    verify_failed = False
     with span("checkpoint_write", step=int(step)):
-        call_with_retry(_write_state, desc="checkpoint state write",
-                        counter=retries)
+        try:
+            call_with_retry(_write_state, desc="checkpoint state write",
+                            counter=retries)
+        except WriteVerifyError:
+            # sticky read-back failure (retries exhausted): off-gang (or
+            # with one process, where the commit agreement is a no-op)
+            # this is a loud refusal; on a real gang the outcome becomes
+            # this rank's vote so the commit aborts EVERYWHERE, never
+            # half-publishes
+            if not _gang_commit or \
+                    coordination.get_coordinator().world == 1:
+                raise
+            verify_failed = True
         full_meta = dict(meta or {}, step=int(step))
         if async_save:
-            _pending.append((path, full_meta))
+            _pending.append((path, full_meta, leaf_digests))
             logger.info("async checkpoint started: %s", path)
         else:
-            # phase boundary: every rank's state is durable before ANY
-            # rank publishes a completion marker
+            # phase boundary: every rank's state is durable AND verified
+            # before ANY rank publishes a completion marker; one corrupt
+            # shard aborts the commit on all ranks
+            gang_failed = verify_failed
             if _gang_commit:
-                coordination.get_coordinator().barrier("ckpt_commit")
-            call_with_retry(lambda: _write_meta(path, full_meta),
-                            desc="checkpoint meta write", counter=retries)
-            logger.info("saved checkpoint: %s", path)
+                gang_failed = coordination.get_coordinator().any_flag(
+                    "ckpt_commit", verify_failed)
+            if gang_failed:
+                reg.counter("ckpt_commit_aborts").inc()
+                logger.error(
+                    "checkpoint commit ABORTED for step %d (%s) — no "
+                    "completion marker published on any rank; training "
+                    "continues and the next periodic save retries",
+                    int(step), "local shard failed read-back verification"
+                    if verify_failed else "a peer rank's shard failed "
+                    "verification")
+                if _is_meta_writer():
+                    shutil.rmtree(path, ignore_errors=True)
+            else:
+                if _verify and _is_meta_writer():
+                    # manifest between the commit agreement and the meta
+                    # marker: it must describe durable bytes, and a dir
+                    # with a manifest but no meta is still half-written
+                    integrity.write_manifest(path, leaves=leaf_digests)
+                call_with_retry(lambda: _write_meta(path, full_meta),
+                                desc="checkpoint meta write",
+                                counter=retries)
+                _record_verified(directory, int(step))
+                logger.info("saved checkpoint: %s", path)
     # duration/bytes telemetry: async saves report the (short) snapshot
     # window here; the drain shows up under ckpt_finalize
     nbytes = _tree_bytes(state)
@@ -372,7 +517,7 @@ def finalize_async_saves() -> None:
             gang_failed = coordination.get_coordinator().any_flag(
                 "ckpt_commit", error is not None)
         if gang_failed:
-            abandoned = [p for p, _ in _pending]
+            abandoned = [item[0] for item in _pending]
             _pending.clear()
             reg.counter("ckpt_failed_total").inc(len(abandoned))
             if error is not None:
@@ -393,10 +538,35 @@ def finalize_async_saves() -> None:
                     shutil.rmtree(path, ignore_errors=True)
             return
         while _pending:
-            path, meta = _pending.pop(0)
+            item = _pending.pop(0)
+            path, meta = item[0], item[1]
+            leaves = item[2] if len(item) > 2 else None
+            if _verify and _is_meta_writer():
+                # the background commit has drained: the files are durable
+                # and digestable now, not at dispatch time
+                integrity.write_manifest(path, leaves=leaves)
             call_with_retry(lambda: _write_meta(path, meta),
                             desc="checkpoint meta write", counter=retries)
+            _record_verified(os.path.dirname(path), int(meta.get("step", 0)))
             logger.info("async checkpoint finalized: %s", path)
+
+
+def join_commit_vote() -> None:
+    """The idle side of the two-phase commit rendezvous.
+
+    A gang rank whose stream ran dry keeps matching its peers' save
+    rendezvous (the commit agreement is a collective), but its step has
+    not advanced since its last save — re-writing the unchanged state was
+    PR 6's acknowledged wasted I/O. This publishes ONLY the rank's
+    (healthy) commit vote; a peer's failed vote is observed and logged,
+    since the peers abandon that save on their side. No-op when the gang
+    commit is off (single process, or resilience disabled)."""
+    if not _gang_commit:
+        return
+    if coordination.get_coordinator().any_flag("ckpt_commit", False):
+        logger.error("checkpoint commit aborted by a peer rank at the "
+                     "save rendezvous (this rank was idle — nothing to "
+                     "abandon locally)")
 
 
 def completed_steps(directory: str) -> list[int]:
@@ -428,12 +598,37 @@ def latest_step(directory: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def latest_verified_step(directory: str) -> Optional[int]:
+    """Newest completed step under ``directory`` that is not PROVABLY
+    corrupt: its manifest re-digests clean, or it predates manifests
+    (``unverified`` — usable, just unprovable). Provably-corrupt steps
+    are skipped with an error log, so resume targeting lands on the step
+    a verified restore will actually accept."""
+    for step in reversed(completed_steps(directory)):
+        path = _step_dir(directory, step)
+        # files-only: the archive's file digest covers every leaf byte,
+        # and the restore this peek is targeting re-verifies leaves
+        # anyway — no need to decode the npz twice per resume
+        report = integrity.verify_checkpoint_dir(path, files_only=True)
+        if report["status"] != "corrupt":
+            return step
+        _record_refused(directory, step)
+        logger.error(
+            "checkpoint %s failed integrity verification (files: %s, "
+            "leaves: %s) — skipping it as a resume candidate", path,
+            report["mismatched_files"], report["mismatched_leaves"])
+    return None
+
+
 def peek_meta(directory: str) -> Optional[dict]:
     """Read the latest checkpoint's meta dict without touching array data —
     used by the CLI to seed the sampler's ``consumed_samples`` before the
     engine restores the full state. Corrupt metas are skipped (the
-    previous completed step wins)."""
-    step = latest_step(directory)
+    previous completed step wins), and with verification on the peek
+    targets the newest step whose digests hold, so the sampler rewind
+    matches the step the verified restore will land on."""
+    step = latest_verified_step(directory) if _verify \
+        else latest_step(directory)
     if step is None:
         return None
     return _read_meta(_step_dir(directory, step))
@@ -446,7 +641,10 @@ def gc_checkpoints(directory: str, keep_last: int,
     Retention: the newest ``keep_last`` completed steps always survive
     (floored at 1 — the newest completed step is NEVER pruned, it is the
     resume point), plus every step divisible by ``keep_every`` when set
-    (periodic keep-forever archives). Half-written dirs are not touched —
+    (periodic keep-forever archives), plus the newest step this process
+    has VERIFIED (save read-back or restore digest match) — GC never
+    prunes past it, so a restore that refuses a newer corrupt step always
+    has its fall-back target on disk. Half-written dirs are not touched —
     ``save_checkpoint`` owns those. Pruned dirs bump ``ckpt_gc_total``.
 
     Meta-writer gated (same convention as ``_write_meta``): on multi-host
@@ -462,6 +660,9 @@ def gc_checkpoints(directory: str, keep_last: int,
     keep = set(steps[-max(int(keep_last), 1):])
     if keep_every:
         keep.update(s for s in steps if s % int(keep_every) == 0)
+    verified = _last_verified.get(os.path.abspath(directory))
+    if verified is not None:
+        keep.add(verified)
     pruned = 0
     for s in steps:
         if s in keep:
@@ -475,6 +676,34 @@ def gc_checkpoints(directory: str, keep_last: int,
     return pruned
 
 
+def _verify_payload_or_raise(path: str, step: int) -> Optional[dict]:
+    """The pre-restore integrity gate shared by both codecs: fire the
+    ``corrupt_restore_at`` drill point, then re-digest every payload file
+    against the manifest BEFORE any byte is deserialized. Returns the
+    manifest (None when absent — a pre-integrity checkpoint restores
+    unverified with an info log) or raises
+    :class:`CheckpointIntegrityError` naming the mismatched files."""
+    faults_mod.fire_path("ckpt_restore", path, int(step))
+    if not _verify:
+        return None
+    manifest = integrity.read_manifest(path)
+    if manifest is None:
+        logger.info("no integrity manifest under %s — restoring "
+                    "unverified (pre-integrity checkpoint)", path)
+        return None
+    reg = get_registry()
+    reg.counter("ckpt_verify_total").inc()
+    bad = integrity.verify_files(path, manifest)
+    if bad:
+        reg.counter("ckpt_verify_failed").inc()
+        _record_refused(os.path.dirname(path), int(step))
+        raise CheckpointIntegrityError(
+            f"checkpoint {path} failed integrity verification: files "
+            f"{bad} do not match the manifest digests — refusing to "
+            f"restore corrupt state")
+    return manifest
+
+
 def load_params(directory: str, step: Optional[int] = None) -> Any:
     """Restore only the params subtree of a saved TrainState.
 
@@ -485,7 +714,9 @@ def load_params(directory: str, step: Optional[int] = None) -> Any:
     """
     step = step if step is not None else latest_step(directory)
     assert step is not None, f"no checkpoint found under {directory}"
-    path = os.path.join(os.path.abspath(_step_dir(directory, step)), "state")
+    step_path = os.path.abspath(_step_dir(directory, step))
+    _verify_payload_or_raise(step_path, int(step))
+    path = os.path.join(step_path, "state")
     ckptr = _get_checkpointer()
     md = ckptr.metadata(path)
     tree = getattr(md, "item_metadata", md)
@@ -516,14 +747,23 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
     size-preserving reshapes — and an Orbax ``state/`` directory through
     the sharded path, so checkpoints from either storage mode load on any
     topology.
+
+    Integrity: the payload's file digests are verified BEFORE any byte is
+    deserialized and the per-leaf digests after (pre-cast for the npz
+    codec, post-restore for single-process Orbax); any mismatch raises
+    :class:`CheckpointIntegrityError` — the loud refusal the engine's
+    fall-back loop consumes. Pre-integrity checkpoints (no manifest)
+    restore unverified with an info log.
     """
     path = os.path.abspath(_step_dir(directory, step))
+    manifest = _verify_payload_or_raise(path, int(step))
     if os.path.exists(os.path.join(path, _LOCAL_STATE)):
         reg = get_registry()
         t0 = time.perf_counter()
         with span("checkpoint_restore", step=int(step)):
             state = call_with_retry(
-                lambda: _restore_state_local(path, abstract_state),
+                lambda: _restore_state_local(path, abstract_state,
+                                             manifest=manifest),
                 desc="checkpoint restore",
                 counter=reg.counter("ckpt_retries_total"))
         reg.histogram("ckpt_restore").record(time.perf_counter() - t0)
@@ -534,6 +774,8 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
             raise RuntimeError(
                 f"checkpoint meta unreadable/corrupt for {path} — refusing "
                 f"to resume without step/consumed_samples")
+        if manifest is not None:
+            _record_verified(directory, int(step))
         logger.info("restored checkpoint: %s (step %d)", path,
                     meta.get("step", step))
         return state, meta
@@ -594,6 +836,24 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
     reg.histogram("ckpt_restore").record(time.perf_counter() - t0)
     reg.counter("ckpt_restores_total").inc()
     reg.gauge("ckpt_bytes").set(_tree_bytes(state))
+    if _verify and manifest is not None and manifest.get("leaves") and \
+            jax.process_count() == 1:
+        # end-to-end leaf check for the Orbax codec: the DESERIALIZED
+        # content must match the digests computed at save (single-process
+        # only — a multi-process host would gather peers' shards to
+        # digest a global leaf; the file digests above already cover the
+        # on-disk bytes there). Recast leaves are skipped by nbytes.
+        bad = integrity.verify_leaves(
+            jax.tree.leaves(jax.device_get(state)), manifest["leaves"])
+        if bad:
+            reg.counter("ckpt_verify_failed").inc()
+            _record_refused(directory, int(step))
+            raise CheckpointIntegrityError(
+                f"checkpoint {path} failed integrity verification: "
+                f"restored leaves {bad} do not match the manifest "
+                f"digests — refusing to resume from corrupt state")
+    if manifest is not None:
+        _record_verified(directory, int(step))
     if reshaped:
         logger.info("adapting pipeline layout of %d leaves on restore",
                     len(reshaped))

@@ -183,6 +183,9 @@ class EagerEngine(BasicEngine):
         # an unmatched commit barrier would wedge a healthy rank's save
         ckpt_lib.set_gang_commit(self.resilience.enabled and
                                  self.coord.world > 1)
+        # integrity manifests + verified restore (docs/resilience.md
+        # "Integrity"; default ON — independent of Resilience.enable)
+        ckpt_lib.set_verify_mode(self.resilience.integrity_verify)
 
         mp_cfg = dict(eng.get("mix_precision") or {})
         self.use_fp16_scaler = bool(mp_cfg.get("use_pure_fp16")) and (
@@ -271,6 +274,11 @@ class EagerEngine(BasicEngine):
         self._eval_step = None
         self._consumed_samples = 0
         self._start_epoch = 0
+        # sample position auto-resume REWOUND the stream to (None until it
+        # runs); fit compares it with the position the restore actually
+        # landed on — an integrity fall-back can land on an older step
+        # than the peek predicted, and the stream must follow
+        self._resume_expected_consumed = None
         # fault injection for restart/elasticity tests (tools/supervise.py)
         self._fault_step = int(os.environ.get("FLEETX_FAULT_STEP") or 0)
 
@@ -556,6 +564,14 @@ class EagerEngine(BasicEngine):
             self._eval_step = jax.jit(
                 eval_step, in_shardings=(self.state_shardings, bs),
                 out_shardings=None)
+        # SDC sentinel hooks (docs/resilience.md "Integrity"): the raw
+        # step fn is kept so a NON-donating twin can be jitted lazily at
+        # the first sentinel check — with the sentinel off (cadence 0)
+        # neither twin nor fingerprint fn is ever built and the loop is
+        # byte-identical to the pre-integrity engine
+        self._train_step_raw = train_step if optimizer is not None else None
+        self._train_step_nodonate = None
+        self._fingerprint_fn = None
 
     def shard_batch(self, batch: dict) -> dict:
         """Place a host batch onto the mesh, sharded over the data axes."""
@@ -598,6 +614,124 @@ class EagerEngine(BasicEngine):
                     total += time.perf_counter() - t0
         return total / max(iters, 1)
 
+    # -------------------------------------------------------- SDC sentinel
+    def _ensure_sentinel_fns(self):
+        """Lazily build the sentinel's compiled pieces: a NON-donating
+        twin of ``train_step`` (the replay must re-execute on the saved
+        state, which donation would have invalidated) and the jitted
+        param-pytree bit-fingerprint. Built only when the sentinel is
+        armed, so cadence 0 compiles nothing extra."""
+        if self._train_step_nodonate is not None:
+            return
+        assert self._train_step_raw is not None, "no optimizer step to replay"
+        from fleetx_tpu.resilience.integrity import params_fingerprint
+
+        bs = batch_sharding(self.mesh)
+        with self._ctx():
+            self._train_step_nodonate = jax.jit(
+                self._train_step_raw,
+                in_shardings=(self.state_shardings, bs),
+                out_shardings=(self.state_shardings, None))
+            self._fingerprint_fn = jax.jit(params_fingerprint)
+
+    def _sdc_check(self, prev_state: TrainState, sharded: dict,
+                   metrics: dict, step: int, gang: bool) -> None:
+        """One SDC sentinel check (docs/resilience.md "Integrity").
+
+        Two probes, both cheap relative to their cadence: (1) REPLAY —
+        re-execute the jitted train step on the saved ``(state, batch)``
+        pair through the same non-donating executable that produced
+        ``metrics`` and compare loss/grad-norm BITWISE (XLA is
+        deterministic on fixed hardware, so any difference is a
+        hardware/memory fault, not noise); (2) FINGERPRINT — the
+        on-device bit-content reduction of the post-step params, compared
+        across dp-replicated ranks via the coordination layer (replicas
+        are bit-identical by construction; a flipped bit in one rank's
+        HBM splits the census). Verdicts are combined collectively on
+        gangs so every rank takes the same ``log | quarantine | abort``
+        action in the same iteration.
+        """
+        res = self.resilience
+        reg = res.registry
+        reg.counter("sdc_checks_total").inc()
+        _, replay = self._train_step_nodonate(prev_state, sharded)
+        evidence = []
+        mismatch = False
+        for key in ("loss", "grad_norm"):
+            if key not in metrics or key not in replay:
+                continue
+            a = np.asarray(jax.device_get(metrics[key]))
+            b = np.asarray(jax.device_get(replay[key]))
+            if a.tobytes() != b.tobytes():
+                mismatch = True
+                evidence.append(f"replay {key}: {a!r} != {b!r}")
+        if mismatch:
+            reg.counter("sdc_replay_mismatches").inc()
+        if gang:
+            # collective verdict BEFORE acting: every rank must mirror
+            # the action in the same iteration or its peers wedge in
+            # their next collective
+            if self.coord.any_flag("sdc_replay", mismatch) and not mismatch:
+                evidence.append("replay mismatch on a peer rank")
+                mismatch = True
+        fp_mismatch = False
+        if gang:
+            fp = int(jax.device_get(self._fingerprint_fn(self.state.params)))
+            census = self.coord.all_gather("sdc_fingerprint", fp)
+            if len(set(census.values())) > 1:
+                fp_mismatch = True
+                reg.counter("sdc_fingerprint_mismatches").inc()
+                evidence.append(
+                    f"cross-replica param fingerprint diverged: {census} "
+                    f"(this rank: {fp})")
+        if not (mismatch or fp_mismatch):
+            return
+        msg = (f"SDC sentinel tripped at step {step}: "
+               + "; ".join(evidence))
+        if res.sentinel_action == "abort":
+            logger.error("%s — aborting (sentinel_action: abort)", msg)
+            raise TrainingAborted(msg)
+        if res.sentinel_action == "quarantine":
+            reg.counter("sdc_quarantines").inc()
+            marker = os.path.join(self.output_dir, "sdc_quarantine.json")
+            import json
+
+            from fleetx_tpu.resilience.integrity import atomic_write
+            os.makedirs(self.output_dir, exist_ok=True)
+            atomic_write(marker, lambda f: json.dump(
+                {"step": int(step), "rank": int(self.coord.rank),
+                 "evidence": evidence,
+                 "quarantines": int(reg.counter("sdc_quarantines").value)},
+                f))
+            logger.error("%s — host quarantined (marker: %s); training "
+                         "continues, schedule this host for replacement",
+                         msg, marker)
+            return
+        logger.error("%s — continuing (sentinel_action: log)", msg)
+
+    def _apply_bitflip(self, state: TrainState) -> TrainState:
+        """The ``bitflip_param_at`` drill: flip the lowest bit of the
+        first element of the first float param leaf — the minimal silent
+        HBM-corruption event, staged deterministically so the sentinel's
+        detectors can be rehearsed in tests."""
+        leaves, treedef = jax.tree.flatten(state.params)
+        for i, leaf in enumerate(leaves):
+            if not jnp.issubdtype(leaf.dtype, jnp.floating) or leaf.size < 1:
+                continue
+            host = np.asarray(jax.device_get(leaf)).copy()
+            raw = host.reshape(-1).view(np.uint8)
+            raw[0] ^= 0x01
+            sharding = getattr(leaf, "sharding", None)
+            flipped = (jax.device_put(host, sharding)
+                       if sharding is not None else jnp.asarray(host))
+            logger.warning("fault injection: flipped one bit in param "
+                           "leaf %d", i)
+            leaves = list(leaves)
+            leaves[i] = flipped
+            return state.replace(params=jax.tree.unflatten(treedef, leaves))
+        logger.warning("fault injection: no float param leaf to bit-flip")
+        return state
+
     # ----------------------------------------------------------------- fit
     def fit(self, train_data_loader: Iterable, valid_data_loader=None,
             epoch_num: int = 1):
@@ -617,6 +751,37 @@ class EagerEngine(BasicEngine):
         it = iter(train_data_loader)
         first = self.module.pretreating_batch(next(it))
         self.prepare(first)
+        expected = self._resume_expected_consumed
+        self._resume_expected_consumed = None
+        if expected is not None and self._consumed_samples != expected:
+            # the restore's integrity fall-back landed on an OLDER step
+            # than auto-resume peeked (a corruption event between the
+            # peek and the load, or a peer rank's corrupt shard moving
+            # the voted step): the stream was rewound — and the lead
+            # batch drawn — at the peeked position, so following it
+            # would silently skip the samples between the two steps
+            if _rewind_sampler(train_data_loader, self._consumed_samples):
+                logger.warning(
+                    "auto-resume fall-back: restore landed at "
+                    "consumed_samples=%d, not the peeked %d — re-rewinding "
+                    "the sampler and re-drawing the lead batch",
+                    self._consumed_samples, expected)
+                if hasattr(it, "close"):
+                    it.close()
+                it = iter(train_data_loader)
+                first = self.module.pretreating_batch(next(it))
+            else:
+                # no sampler to reposition and the already-drawn lead
+                # batch may be from the wrong position — the operator
+                # must re-position the stream; say so loudly rather than
+                # silently skipping the samples between the two steps
+                logger.error(
+                    "auto-resume fall-back: restore landed at "
+                    "consumed_samples=%d but the loader has no "
+                    "consumed_samples sampler — the stream MUST be "
+                    "positioned at global sample %d or already-trained "
+                    "data replays / new data is skipped",
+                    self._consumed_samples, self._consumed_samples)
 
         # consumed_samples counts GLOBAL samples (the sampler's unit): the
         # per-host leading dim times the number of hosts
@@ -875,20 +1040,26 @@ class EagerEngine(BasicEngine):
             stream_done = False  # this rank's stream ran dry (gang mode:
             # awaiting the agreed exit — never a unilateral break)
             vote_every = res.preemption_sync_every
+            # SDC sentinel cadence (docs/resilience.md "Integrity"): 0 =
+            # off, and the loop below is then byte-identical to the
+            # sentinel-less engine (no twin step fn, no extra collectives)
+            sent_every = (res.sentinel_every
+                          if self._train_step_raw is not None else 0)
             shared_mesh = gang_loop and any(
                 d.process_index != jax.process_index()
                 for d in np.asarray(self.mesh.devices).flat)
             if gang_loop and (res.guard is not None or gang_wd is not None
-                              or shared_mesh):
-                # the guard's window vote and the gang watchdog's call
-                # counter stay lockstep only while every rank runs every
-                # iteration's full body — the control vote must then run
-                # every iteration so a rank's exhaustion is agreed BEFORE
-                # any same-iteration collective could diverge. A mesh that
-                # spans processes forces the same cadence: every train
-                # step is a cross-process computation there, so a locally
-                # dry rank idling between votes would strand its peers
-                # inside the collective
+                              or sent_every > 0 or shared_mesh):
+                # the guard's window vote, the gang watchdog's call
+                # counter and the sentinel's replay/fingerprint
+                # collectives stay lockstep only while every rank runs
+                # every iteration's full body — the control vote must then
+                # run every iteration so a rank's exhaustion is agreed
+                # BEFORE any same-iteration collective could diverge. A
+                # mesh that spans processes forces the same cadence: every
+                # train step is a cross-process computation there, so a
+                # locally dry rank idling between votes would strand its
+                # peers inside the collective
                 vote_every = 1
             while True:
                 if gang_loop:
@@ -943,9 +1114,18 @@ class EagerEngine(BasicEngine):
                                 vote_round % self.save_steps == 0 and \
                                 vote_round != last_save_round:
                             last_save_round = vote_round
-                            last_save = step
                             with wd_quiet():
-                                self.save()
+                                if step == last_save:
+                                    # PR 6's acknowledged wart, fixed: the
+                                    # state has not changed since this
+                                    # rank's last save — match the peers'
+                                    # two-phase commit rendezvous with
+                                    # ONLY a healthy vote, skipping the
+                                    # redundant state write
+                                    ckpt_lib.join_commit_vote()
+                                else:
+                                    last_save = step
+                                    self.save()
                         continue
                 else:
                     if res.preempted:
@@ -964,12 +1144,27 @@ class EagerEngine(BasicEngine):
                 # the span covers dispatch, not device runtime (the step is
                 # async); device time shows up in the XLA trace the
                 # TraceAnnotation nests under
+                # sentinel steps run through the NON-donating twin so the
+                # pre-step state survives for the replay; keyed on the
+                # lockstep vote_round in gang mode (every rank must join
+                # the replay/fingerprint collectives in the same
+                # iteration), on the step counter off-gang
+                run_sentinel = bool(sent_every) and (
+                    (vote_round if gang_loop else step + 1)
+                    % sent_every == 0)
+                prev_state = self.state if run_sentinel else None
                 with self.obs.span("train_step", step=step):
                     # donate_argnums=(0,) deletes the old state's buffers;
                     # the explicit rebind keeps the donated->rebound
                     # ordering visible (the one-line tuple assign was
                     # equally safe — lint: donated-buffer-reuse docs)
-                    new_state, metrics = self._train_step(self.state, sharded)
+                    if run_sentinel:
+                        self._ensure_sentinel_fns()
+                        new_state, metrics = self._train_step_nodonate(
+                            self.state, sharded)
+                    else:
+                        new_state, metrics = self._train_step(self.state,
+                                                              sharded)
                     self.state = new_state
                 window += 1
                 self._consumed_samples += global_batch
@@ -984,6 +1179,20 @@ class EagerEngine(BasicEngine):
                     # (the whole point of the distributed mode) can fire
                     with wd_quiet():
                         gang_wd.check(step)
+                if run_sentinel:
+                    # the sentinel's own cost lands in the sdc_sentinel
+                    # span (bench.py reports it next to the step time);
+                    # the replay is a full step and the gang census can
+                    # block on a wedged peer, so the stall detector is
+                    # suspended like every other long host phase
+                    with self.obs.timed_span("sdc_sentinel"), wd_quiet():
+                        self._sdc_check(prev_state, sharded, metrics,
+                                        step, gang_loop)
+                if res.faults.take_bitflip(step):
+                    # the silent-HBM-corruption drill: flips a bit AFTER
+                    # this iteration's checks, so the NEXT sentinel round
+                    # must catch it (cross-replica fingerprint on gangs)
+                    self.state = self._apply_bitflip(self.state)
                 if window % self.logging_freq == 0:
                     # ONE device->host sync per logging window: fetch the
                     # whole metrics pytree at once and convert on the host,
@@ -1242,6 +1451,7 @@ class EagerEngine(BasicEngine):
             return
         self.ckpt_dir = target
         consumed = int(meta_d.get("consumed_samples", 0))
+        self._resume_expected_consumed = consumed
         if _rewind_sampler(loader, consumed):
             logger.info("auto-resume: sampler rewound to "
                         "consumed_samples=%d", consumed)
@@ -1271,34 +1481,65 @@ class EagerEngine(BasicEngine):
         the agreed step refuse loudly (divergent storage is an operator
         problem, not something to paper over with per-host guesses), and a
         host with a NEWER local step defers to rank 0 with an error log.
+
+        Integrity fall-back (docs/resilience.md "Integrity"): a step that
+        fails digest verification is refused loudly and the NEWEST OLDER
+        completed step is tried instead (``ckpt_verify_fallbacks``
+        counter), until one verifies or none remain — a byte-corrupted
+        latest checkpoint costs one rollback window, never a run trained
+        on garbage. On gangs each attempt's verdict is voted, so one
+        rank's corrupt shard makes EVERY rank fall back to the same step.
         """
         ckpt_lib.finalize_async_saves()
         directory = directory or self.output_dir
-        local = ckpt_lib.latest_step(directory)
-        step = self.coord.broadcast("resume_step", local)
-        if step is None:
-            if local is not None:
-                raise RuntimeError(
-                    f"divergent checkpoint views: this rank has step "
-                    f"{local} under {directory} but rank 0 found no "
-                    f"completed checkpoint — refusing to resume from two "
-                    f"different steps")
-            logger.info("no checkpoint found under %s", directory)
-            return False
-        if step != local:
-            if step not in ckpt_lib.completed_steps(directory):
-                raise RuntimeError(
-                    f"divergent checkpoint views: rank 0 resumes step "
-                    f"{step} but this rank's {directory} lacks it (local "
-                    f"latest: {local})")
-            logger.error("divergent checkpoint views: local latest %s != "
-                         "rank-0 step %d — resuming from the rank-0 step",
-                         local, step)
+        gang_vote = self.resilience.enabled and self.coord.world > 1
         abstract = jax.tree.map(
             lambda s, x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             self.state_shardings, meta.unbox(jax.eval_shape(lambda: self.state)))
-        state, meta_d = ckpt_lib.load_checkpoint(directory, step, abstract,
-                                                 adapt_layout=True)
+        local = ckpt_lib.latest_step(directory)
+        refused: list = []
+        while True:
+            step = self.coord.broadcast("resume_step", local)
+            if step is None:
+                if local is not None:
+                    raise RuntimeError(
+                        f"divergent checkpoint views: this rank has step "
+                        f"{local} under {directory} but rank 0 found no "
+                        f"completed checkpoint — refusing to resume from "
+                        f"two different steps")
+                if refused:
+                    raise RuntimeError(
+                        f"every checkpoint under {directory} failed "
+                        f"integrity verification (refused steps: "
+                        f"{refused}) — refusing to restore corrupt state")
+                logger.info("no checkpoint found under %s", directory)
+                return False
+            if step != local:
+                if step not in ckpt_lib.completed_steps(directory):
+                    raise RuntimeError(
+                        f"divergent checkpoint views: rank 0 resumes step "
+                        f"{step} but this rank's {directory} lacks it "
+                        f"(local latest: {local})")
+                logger.error("divergent checkpoint views: local latest %s "
+                             "!= rank-0 step %d — resuming from the "
+                             "rank-0 step", local, step)
+            failed_local = False
+            try:
+                state, meta_d = ckpt_lib.load_checkpoint(
+                    directory, step, abstract, adapt_layout=True)
+            except ckpt_lib.CheckpointIntegrityError as e:
+                failed_local = True
+                logger.error("refusing checkpoint step %d: %s", step, e)
+            failed = (self.coord.any_flag("restore_verify", failed_local)
+                      if gang_vote else failed_local)
+            if not failed:
+                break
+            self.resilience.registry.counter("ckpt_verify_fallbacks").inc()
+            refused.append(step)
+            logger.warning("falling back past corrupt checkpoint step %d "
+                           "to the newest older completed step", step)
+            local = max((s for s in ckpt_lib.completed_steps(directory)
+                         if s < step), default=None)
         # re-box: restored leaves are raw arrays; re-attach logical metadata
         self.state = jax.tree.map(
             lambda box, leaf: box.replace_boxed(leaf) if isinstance(box, meta.AxisMetadata) else leaf,

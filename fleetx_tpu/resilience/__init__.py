@@ -15,7 +15,11 @@ package owns it natively, one module per failure mode:
 - ``faults``       — deterministic fault injection driving the tests;
 - ``coordination`` — cross-process agreement primitives (timed barrier,
   rank-0 broadcast, any-rank OR, majority vote) that turn each of the
-  above into a gang-wide decision on multi-host pods.
+  above into a gang-wide decision on multi-host pods;
+- ``integrity``    — state-integrity layer: checkpoint digest manifests
+  with verified restore + fall-back, the SDC sentinel's replay compare
+  and cross-replica param fingerprint, and the supervisor preflight
+  self-test.
 
 ``Resilience`` is the engine-facing facade built from the ``Resilience:``
 YAML block (``utils/config.py``): with the block absent or disabled every
@@ -38,6 +42,8 @@ from fleetx_tpu.resilience.coordination import (  # noqa: F401
 from fleetx_tpu.resilience.faults import FaultPlan, InjectedFault  # noqa: F401
 from fleetx_tpu.resilience.guard import (  # noqa: F401
     TrainingAborted, TrainingGuard)
+from fleetx_tpu.resilience.integrity import (  # noqa: F401
+    CheckpointIntegrityError, WriteVerifyError)
 from fleetx_tpu.resilience.policy import (  # noqa: F401
     RetryPolicy, call_with_retry, is_transient, set_default_policy)
 from fleetx_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
@@ -46,9 +52,13 @@ from fleetx_tpu.resilience.watchdog import GangWatchdog, StepWatchdog  # noqa: F
 __all__ = [
     "Resilience", "RetryPolicy", "TrainingGuard", "TrainingAborted",
     "PreemptionHandler", "StepWatchdog", "GangWatchdog", "FaultPlan",
-    "InjectedFault", "CoordinationTimeout", "call_with_retry", "is_transient",
+    "InjectedFault", "CoordinationTimeout", "CheckpointIntegrityError",
+    "WriteVerifyError", "call_with_retry", "is_transient",
     "set_default_policy", "get_coordinator", "most_severe",
 ]
+
+#: SDC sentinel actions, in the order the Integrity docs list them
+SENTINEL_ACTIONS = ("log", "quarantine", "abort")
 
 
 def _on(value, default: bool = True) -> bool:
@@ -86,6 +96,23 @@ class Resilience:
         self._watchdog_cfg: dict = {}
         self.preemption_sync_every = 1
         self.faults = FaultPlan()
+        # state-integrity layer (docs/resilience.md "Integrity"): manifest
+        # verification defaults ON even with the runtime disabled —
+        # persisted state is never trusted blindly — while the sentinel is
+        # strictly opt-in (cadence 0 keeps the train loop byte-identical)
+        integ_cfg = dict(cfg.get("integrity") or {})
+        self.integrity_verify = _on(integ_cfg.get("verify_checkpoints"))
+        self.sentinel_every = 0
+        self.sentinel_action = "log"
+        if self.enabled:
+            self.sentinel_every = max(
+                int(integ_cfg.get("sentinel_every") or 0), 0)
+            self.sentinel_action = str(
+                integ_cfg.get("sentinel_action") or "log")
+            if self.sentinel_action not in SENTINEL_ACTIONS:
+                raise ValueError(
+                    f"Resilience.integrity.sentinel_action must be one of "
+                    f"{SENTINEL_ACTIONS}, got {self.sentinel_action!r}")
         if not self.enabled:
             # inert AND isolating: a disabled engine must not inherit a
             # previous engine's armed fault plan, tuned retry policy or

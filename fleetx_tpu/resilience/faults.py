@@ -16,6 +16,20 @@ the REAL recovery machinery end-to-end:
   transient ``InjectedFault(OSError)`` (an I/O blip the retry policy must
   absorb).
 
+The state-integrity layer (``docs/resilience.md`` "Integrity") adds three
+corruption drills so every detector is rehearsed the way the matrix above
+rehearses crashes:
+
+- ``bitflip_param_at: K``    — flip one bit in a param leaf after step K
+  (a silent HBM/compute fault; the SDC sentinel's cross-replica
+  fingerprint must trip);
+- ``corrupt_ckpt_at: K``     — flip a byte in step K's just-written
+  checkpoint payload, STICKY across write retries (the save-side
+  read-back verification must fail the ``ckpt_commit`` vote);
+- ``corrupt_restore_at: K``  — flip a byte in step K's payload just
+  before a restore reads it (restore must refuse and fall back to the
+  newest checkpoint that verifies).
+
 Plans come from the ``Resilience.faults`` config block or the
 ``FLEETX_FAULTS`` env var (``"sigterm_at=5,ckpt_write_fail_times=1,
 nan_loss_at=4:5"``), env winning — so a restart harness can inject into an
@@ -85,11 +99,17 @@ class FaultPlan:
     def __init__(self, data_raise_at: Optional[int] = None,
                  nan_loss_at: Optional[list] = None,
                  sigterm_at: Optional[int] = None,
-                 ckpt_write_fail_times: int = 0):
+                 ckpt_write_fail_times: int = 0,
+                 bitflip_param_at: Optional[int] = None,
+                 corrupt_ckpt_at: Optional[int] = None,
+                 corrupt_restore_at: Optional[int] = None):
         self.data_raise_at = data_raise_at
         self.nan_loss_at = set(int(s) for s in (nan_loss_at or ()))
         self.sigterm_at = sigterm_at
         self.ckpt_write_fail_times = int(ckpt_write_fail_times or 0)
+        self.bitflip_param_at = bitflip_param_at
+        self.corrupt_ckpt_at = corrupt_ckpt_at
+        self.corrupt_restore_at = corrupt_restore_at
 
     @classmethod
     def from_cfg(cls, cfg: Optional[dict],
@@ -114,21 +134,28 @@ class FaultPlan:
         nan_at = merged.get("nan_loss_at")
         if isinstance(nan_at, int):
             nan_at = [nan_at]
+        def opt_int(key: str) -> Optional[int]:
+            return None if merged.get(key) is None else int(merged[key])
+
         return cls(
-            data_raise_at=(None if merged.get("data_raise_at") is None
-                           else int(merged["data_raise_at"])),
+            data_raise_at=opt_int("data_raise_at"),
             nan_loss_at=nan_at,
-            sigterm_at=(None if merged.get("sigterm_at") is None
-                        else int(merged["sigterm_at"])),
+            sigterm_at=opt_int("sigterm_at"),
             ckpt_write_fail_times=int(merged.get("ckpt_write_fail_times")
-                                      or 0))
+                                      or 0),
+            bitflip_param_at=opt_int("bitflip_param_at"),
+            corrupt_ckpt_at=opt_int("corrupt_ckpt_at"),
+            corrupt_restore_at=opt_int("corrupt_restore_at"))
 
     @property
     def armed(self) -> bool:
         """True when any fault is configured."""
         return bool(self.data_raise_at is not None or self.nan_loss_at
                     or self.sigterm_at is not None
-                    or self.ckpt_write_fail_times)
+                    or self.ckpt_write_fail_times
+                    or self.bitflip_param_at is not None
+                    or self.corrupt_ckpt_at is not None
+                    or self.corrupt_restore_at is not None)
 
     # ------------------------------------------------------------- triggers
     def on_batch(self, index: int, batch: Any) -> Any:
@@ -162,11 +189,33 @@ class FaultPlan:
             logger.warning("fault injection: SIGTERM self at step %d", step)
             os.kill(os.getpid(), signal.SIGTERM)
 
+    def take_bitflip(self, step: int) -> bool:
+        """True (once) when the param bit-flip is due at ``step`` — the
+        engine then flips one bit in its live state, staging the silent
+        HBM-corruption event the SDC sentinel exists to catch."""
+        if self.bitflip_param_at is not None and \
+                step >= self.bitflip_param_at:
+            self.bitflip_param_at = None
+            return True
+        return False
+
     def fire(self, point: str) -> None:
         """Named-point hook for deep layers (``"ckpt_write"``)."""
         if point == "ckpt_write" and self.ckpt_write_fail_times > 0:
             self.ckpt_write_fail_times -= 1
             raise InjectedFault("injected checkpoint-write failure")
+
+    def fire_path(self, point: str, path: str, step: int) -> None:
+        """Corruption hooks keyed on a checkpoint step directory:
+        ``"ckpt_written"`` fires after step ``corrupt_ckpt_at``'s state
+        write (STICKY — every retry's rewrite is re-corrupted, so the
+        save-side verification genuinely exhausts the policy), and
+        ``"ckpt_restore"`` fires before step ``corrupt_restore_at`` is
+        read back (idempotent — re-corrupting corrupt bytes is fine)."""
+        due = {"ckpt_written": self.corrupt_ckpt_at,
+               "ckpt_restore": self.corrupt_restore_at}.get(point)
+        if due is not None and int(step) == int(due):
+            _corrupt_payload(path, point)
 
 
 # ---------------------------------------------------------------------------
@@ -194,3 +243,34 @@ def fire(point: str) -> None:
     nothing is armed) — the one-liner deep layers call."""
     if _active is not None:
         _active.fire(point)
+
+
+def fire_path(point: str, path: str, step: int) -> None:
+    """Trigger a path-keyed corruption point on the active plan (no-op
+    when nothing is armed) — ``core/checkpoint.py``'s one-liner."""
+    if _active is not None:
+        _active.fire_path(point, path, step)
+
+
+def _corrupt_payload(path: str, point: str) -> None:
+    """Flip one byte in the middle of the first payload file under
+    ``path`` (deterministic: sorted walk, metadata markers skipped) — the
+    exact bit-rot shape storage hands back in the wild."""
+    from fleetx_tpu.resilience import integrity
+
+    for rel in integrity._payload_files(path):
+        target = os.path.join(path, rel)
+        size = os.path.getsize(target)
+        if size == 0:
+            continue
+        offset = size // 2
+        with open(target, "r+b") as f:
+            f.seek(offset)
+            byte = f.read(1)
+            f.seek(offset)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        logger.warning("fault injection: corrupted byte %d of %s (%s)",
+                       offset, target, point)
+        return
+    logger.warning("fault injection: no payload file to corrupt under %s",
+                   path)

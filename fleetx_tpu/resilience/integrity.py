@@ -1,0 +1,410 @@
+"""State-integrity layer: digests, manifests, SDC fingerprints, preflight.
+
+PRs 4 and 6 made the runtime survive *loud* failures; every byte the
+system persists or computes was still trusted blindly. At fleet scale the
+dominant UNDETECTED failure mode is silent data corruption — a bit-flipped
+checkpoint shard, a truncated ``state.npz`` leaf, a defective core
+corrupting one replica's params — so this module gives every piece of
+state a verifiable identity:
+
+- **content digests** (stdlib ``zlib.crc32`` — crc32c/xxhash-class speed,
+  no new dependency): per-leaf digests of a state pytree and per-file
+  digests of a checkpoint directory's payload;
+- **integrity manifests** (``fleetx_integrity.json``): written next to
+  the meta marker at save for BOTH codecs (Orbax and the per-rank npz
+  path), re-verified on restore and by the offline auditor
+  (``tools/verify_ckpt.py``);
+- **params fingerprint**: a cheap on-device bit-content reduction of the
+  param pytree, compared across dp-replicated ranks by the engine's SDC
+  sentinel (``docs/resilience.md`` "Integrity");
+- **preflight selftest** (``python -m fleetx_tpu.resilience.integrity
+  --selftest``): a short compute+digest self-test ``tools/supervise.py
+  --preflight`` runs per gang member before forming the gang.
+
+Module-level imports stay stdlib+numpy so the selftest entry point and
+the offline auditor run without dragging in jax; jax is imported lazily
+where device arrays actually appear.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = [
+    "MANIFEST_NAME", "CheckpointIntegrityError", "WriteVerifyError",
+    "atomic_write", "digest_bytes", "digest_array", "tree_digests",
+    "file_digests", "write_manifest", "read_manifest", "verify_files",
+    "verify_leaves", "verify_npz_leaves", "verify_checkpoint_dir",
+    "params_fingerprint", "selftest",
+]
+
+#: manifest file name inside a ``step_<N>`` checkpoint directory
+MANIFEST_NAME = "fleetx_integrity.json"
+
+#: files that are checkpoint *metadata*, never digested as payload
+_NON_PAYLOAD = {"fleetx_meta.json", MANIFEST_NAME}
+
+#: streaming chunk for file digests (bounded memory on multi-GB shards)
+_CHUNK = 1 << 20
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint failed digest verification at restore.
+
+    Deliberately NOT an ``OSError``: re-reading corrupt bytes does not
+    un-corrupt them, so the retry policy must never absorb this — the
+    caller's contract is a loud refusal plus fall-back to the newest
+    checkpoint that *does* verify (``EagerEngine.load``).
+    """
+
+
+class WriteVerifyError(OSError):
+    """A just-written checkpoint failed its read-back verification.
+
+    An ``OSError`` on purpose: a torn write is transient-shaped — the
+    retry policy re-dispatches the whole write — while a STICKY failure
+    (a dying disk, an injected drill) exhausts the retries and surfaces
+    as this error, which ``save_checkpoint`` turns into a failed
+    ``ckpt_commit`` vote on gangs.
+    """
+
+
+def atomic_write(target: str, write, mode: str = "w") -> None:
+    """Publish a file all-or-nothing: temp file + fsync + ``os.replace``,
+    with the temp removed on any failure so a crashed writer never leaves
+    a torn payload (or a truncated marker) behind the final name."""
+    tmp = f"{target}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            write(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+# ---------------------------------------------------------------------------
+# digests
+# ---------------------------------------------------------------------------
+
+def digest_bytes(data: bytes, seed: int = 0) -> int:
+    """crc32 of ``data`` (unsigned 32-bit int, stdlib-only)."""
+    return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+def digest_array(arr: Any) -> dict:
+    """Content digest of one array leaf: crc32 of its C-contiguous bytes
+    plus the shape/dtype/nbytes needed to compare across codecs (the crc
+    is byte-content only, so it survives leading-dim reshapes and the
+    npy format's extension-dtype flattening to raw void)."""
+    host = np.ascontiguousarray(np.asarray(arr))
+    return {"crc32": digest_bytes(host.tobytes()),
+            "dtype": str(host.dtype), "shape": list(host.shape),
+            "nbytes": int(host.nbytes)}
+
+
+def tree_digests(state: Any) -> list:
+    """Per-leaf digests of a state pytree in flatten order — the order
+    both checkpoint codecs store leaves in, so index ``i`` here is
+    ``leaf_i`` on disk."""
+    import jax
+
+    return [digest_array(leaf)
+            for leaf in jax.tree.leaves(jax.device_get(state))]
+
+
+def _payload_files(path: str) -> Iterable[str]:
+    """Relative paths of every payload file under ``path``, sorted for a
+    deterministic manifest (metadata markers and temp litter excluded)."""
+    out = []
+    for root, _, names in os.walk(path):
+        for name in names:
+            if name in _NON_PAYLOAD or ".tmp." in name:
+                continue
+            out.append(os.path.relpath(os.path.join(root, name), path))
+    return sorted(out)
+
+
+def _digest_file(target: str) -> dict:
+    """Streaming crc32 + size of one file."""
+    crc = 0
+    size = 0
+    with open(target, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return {"crc32": crc & 0xFFFFFFFF, "size": size}
+
+
+def file_digests(path: str) -> dict:
+    """Relative path → ``{crc32, size}`` for every payload file under a
+    checkpoint step directory (recursive — Orbax nests its shard files
+    under ``state/``)."""
+    return {rel: _digest_file(os.path.join(path, rel))
+            for rel in _payload_files(path)}
+
+
+# ---------------------------------------------------------------------------
+# manifests
+# ---------------------------------------------------------------------------
+
+def write_manifest(path: str, leaves: Optional[list] = None) -> dict:
+    """Digest the payload files under ``path`` (which must be durable by
+    now — after the commit barrier on gangs) and atomically publish the
+    integrity manifest; ``leaves`` carries the per-leaf digests computed
+    from the in-memory state at save time. Returns the manifest dict."""
+    manifest = {"version": 1, "files": file_digests(path)}
+    if leaves is not None:
+        manifest["leaves"] = leaves
+    atomic_write(os.path.join(path, MANIFEST_NAME),
+                 lambda f: json.dump(manifest, f))
+    return manifest
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    """The step dir's integrity manifest, or None when absent/corrupt
+    (corrupt manifests log a warning — the checkpoint is then treated as
+    unverifiable, exactly like a pre-integrity checkpoint)."""
+    target = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(target):
+        return None
+    try:
+        with open(target) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+        logger.warning("corrupt integrity manifest %s (%s) — treating %s "
+                       "as unverifiable", target, e, path)
+        return None
+    if not isinstance(manifest, dict) or "files" not in manifest:
+        logger.warning("malformed integrity manifest %s — treating %s as "
+                       "unverifiable", target, path)
+        return None
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# verification
+# ---------------------------------------------------------------------------
+
+def verify_files(path: str, manifest: dict) -> list:
+    """Re-digest the manifest's files on disk; returns the relative paths
+    that are missing or whose crc32/size changed (empty = verified)."""
+    bad = []
+    for rel, want in sorted(manifest.get("files", {}).items()):
+        target = os.path.join(path, rel)
+        if not os.path.exists(target):
+            bad.append(rel)
+            continue
+        got = _digest_file(target)
+        if got["crc32"] != int(want["crc32"]) or \
+                got["size"] != int(want["size"]):
+            bad.append(rel)
+    return bad
+
+
+def verify_leaves(arrays: Iterable[Any], manifest_leaves: list) -> list:
+    """Compare loaded leaf arrays against their manifest digests; returns
+    the mismatching leaf indices.
+
+    Only byte content is compared (crc32 + nbytes): leading-dim reshapes
+    and the npy format's void-view of extension dtypes keep the bytes
+    identical. A leaf whose byte COUNT differs from the manifest was
+    restored under a changed precision config (a legitimate recast), so
+    it is skipped — content verification across a dtype cast is
+    impossible by construction.
+    """
+    bad = []
+    for i, arr in enumerate(arrays):
+        if i >= len(manifest_leaves):
+            break
+        want = manifest_leaves[i]
+        host = np.ascontiguousarray(np.asarray(arr))
+        if int(host.nbytes) != int(want["nbytes"]):
+            continue  # recast on restore — not comparable
+        if digest_bytes(host.tobytes()) != int(want["crc32"]):
+            bad.append(i)
+    return bad
+
+
+def verify_npz_leaves(path: str, manifest_leaves: list,
+                      npz_name: str = "state.npz") -> list:
+    """Read-back verification of a just-written (or about-to-be-restored)
+    npz snapshot: reload every leaf from disk and compare its bytes
+    against the in-memory digests; returns mismatching leaf indices. An
+    archive too corrupt to decode at all (the zip layer's own CRC check
+    fires first) reports EVERY leaf as mismatched rather than leaking the
+    decoder's exception."""
+    bad = []
+    try:
+        with np.load(os.path.join(path, npz_name)) as data:
+            for i, want in enumerate(manifest_leaves):
+                key = f"leaf_{i}"
+                if key not in data:
+                    bad.append(i)
+                    continue
+                host = np.ascontiguousarray(data[key])
+                if int(host.nbytes) != int(want["nbytes"]) or \
+                        digest_bytes(host.tobytes()) != int(want["crc32"]):
+                    bad.append(i)
+    except Exception as e:  # noqa: BLE001 — undecodable == all corrupt
+        logger.warning("npz snapshot %s unreadable during verification "
+                       "(%s: %s)", os.path.join(path, npz_name),
+                       type(e).__name__, e)
+        return list(range(len(manifest_leaves)))
+    return bad
+
+
+def verify_checkpoint_dir(path: str, files_only: bool = False) -> dict:
+    """Offline verification of one ``step_<N>`` directory.
+
+    Returns ``{"status": "ok" | "corrupt" | "unverified",
+    "files_checked": N, "leaves_checked": N, "mismatched_files": [...],
+    "mismatched_leaves": [...]}``. ``unverified`` means no (readable)
+    manifest — a pre-integrity checkpoint, usable but unprovable.
+    ``files_only`` skips the npz leaf decode (the file digest already
+    covers every byte of the archive) — the cheap form resume targeting
+    uses, since the restore itself re-verifies leaves anyway.
+    """
+    manifest = read_manifest(path)
+    if manifest is None:
+        return {"status": "unverified", "files_checked": 0,
+                "leaves_checked": 0, "mismatched_files": [],
+                "mismatched_leaves": []}
+    bad_files = verify_files(path, manifest)
+    bad_leaves: list = []
+    leaves = manifest.get("leaves")
+    leaves_checked = 0
+    npz = os.path.join(path, "state.npz")
+    if not files_only and leaves and os.path.exists(npz):
+        leaves_checked = len(leaves)
+        try:
+            bad_leaves = verify_npz_leaves(path, leaves)
+        except Exception as e:  # noqa: BLE001 — unreadable == corrupt
+            logger.warning("npz leaf verification failed to read %s (%s)",
+                           npz, e)
+            bad_leaves = list(range(len(leaves)))
+    status = "corrupt" if (bad_files or bad_leaves) else "ok"
+    return {"status": status,
+            "files_checked": len(manifest.get("files", {})),
+            "leaves_checked": leaves_checked,
+            "mismatched_files": bad_files,
+            "mismatched_leaves": bad_leaves}
+
+
+# ---------------------------------------------------------------------------
+# on-device params fingerprint (the SDC sentinel's cross-replica probe)
+# ---------------------------------------------------------------------------
+
+def params_fingerprint(params: Any):
+    """A cheap on-device bit-content reduction of a param pytree.
+
+    Every leaf is bitcast to unsigned integers and summed with uint32
+    wraparound; leaf sums are mixed positionally so swapped leaves don't
+    cancel. dp-replicated ranks hold bit-identical replicas and run the
+    identical reduction, so their fingerprints match EXACTLY — any
+    divergence (a flipped bit in one replica's HBM) changes the value.
+    Designed to be jitted by the engine and compared across ranks via the
+    coordination layer's ``all_gather``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    total = jnp.uint32(0)
+    for leaf in jax.tree.leaves(params):
+        x = leaf
+        if x.dtype == jnp.bool_:
+            bits = x.astype(jnp.uint32)
+        elif jnp.issubdtype(x.dtype, jnp.floating):
+            width = x.dtype.itemsize * 8
+            target = {8: jnp.uint8, 16: jnp.uint16, 32: jnp.uint32}.get(width)
+            if target is None:  # f64 and exotics: deterministic downcast
+                x = x.astype(jnp.float32)
+                target = jnp.uint32
+            bits = jax.lax.bitcast_convert_type(x, target).astype(jnp.uint32)
+        elif jnp.issubdtype(x.dtype, jnp.signedinteger) and \
+                x.dtype.itemsize == 4:
+            bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        else:
+            bits = x.astype(jnp.uint32)
+        total = total * jnp.uint32(1000003) + jnp.sum(
+            bits, dtype=jnp.uint32)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# preflight selftest (tools/supervise.py --preflight)
+# ---------------------------------------------------------------------------
+
+#: crc32 of the deterministic selftest input block, as a HARD-CODED
+#: literal — pinning the digest machinery itself only works if the
+#: expected value was computed somewhere else: a host whose zlib/crc
+#: tables are deterministically corrupt would reproduce its own wrong
+#: value if this were evaluated at import time on the same host
+_SELFTEST_INPUT_CRC = 0x2F5700C1
+
+
+def selftest(size: int = 192, repeats: int = 3) -> dict:
+    """A short compute+digest self-test for one host.
+
+    Runs a seeded float32 matmul ``repeats`` times and digests each
+    result: on healthy hardware every repeat is bit-identical, so any
+    digest divergence means the host computes or remembers wrong — the
+    exact class of silent fault a gang must refuse to include. The digest
+    machinery itself is pinned against a known crc. The
+    ``FLEETX_SELFTEST_FORCE_FAIL`` env knob (empty/``*`` or this member's
+    ``FLEETX_PREFLIGHT_MEMBER`` index) fails the test on purpose — the
+    drill hook the preflight tests use.
+    """
+    import time
+
+    member = os.environ.get("FLEETX_PREFLIGHT_MEMBER", "0")
+    t0 = time.perf_counter()
+    rng = np.random.RandomState(20260803)
+    a = rng.rand(size, size).astype(np.float32)
+    b = rng.rand(size, size).astype(np.float32)
+    digests = [digest_bytes(np.ascontiguousarray(a @ b).tobytes())
+               for _ in range(max(int(repeats), 2))]
+    crc_ok = digest_bytes(
+        np.arange(4096, dtype=np.uint32).tobytes()) == _SELFTEST_INPUT_CRC
+    compute_ok = len(set(digests)) == 1
+    forced = os.environ.get("FLEETX_SELFTEST_FORCE_FAIL")
+    forced_fail = forced is not None and forced in ("", "*", member)
+    ok = compute_ok and crc_ok and not forced_fail
+    return {"ok": ok, "member": member, "compute_ok": compute_ok,
+            "crc_ok": crc_ok, "forced_fail": forced_fail,
+            "digests": digests,
+            "elapsed_s": round(time.perf_counter() - t0, 4)}
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``python -m fleetx_tpu.resilience.integrity --selftest`` entry
+    point: JSON report on stdout, exit 0 on a healthy host, 1 otherwise."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="fleetx integrity selftest (preflight)")
+    parser.add_argument("--selftest", action="store_true",
+                        help="run the compute+digest self-test")
+    args = parser.parse_args(argv)
+    if not args.selftest:
+        parser.error("nothing to do (pass --selftest)")
+    report = selftest()
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -283,6 +283,26 @@ def process_resilience_config(config: AttrDict) -> AttrDict:
         raise ValueError(
             f"Resilience.watchdog.gang_sync_steps must be >= 0 "
             f"(0 disables the gang barrier), got {gang_steps!r}")
+    # state-integrity knobs (docs/resilience.md "Integrity"): a typo'd
+    # sentinel action would otherwise only surface when the sentinel
+    # first TRIPS — the worst possible moment to discover the config
+    # cannot say what to do about a corrupt replica
+    integ = res.get("integrity") or {}
+    sentinel = integ.get("sentinel_every")
+    if sentinel is not None and int(sentinel) < 0:
+        raise ValueError(
+            f"Resilience.integrity.sentinel_every must be >= 0 "
+            f"(0 disables the SDC sentinel), got {sentinel!r}")
+    action = integ.get("sentinel_action")
+    if action is not None and action not in ("log", "quarantine", "abort"):
+        raise ValueError(
+            f"Resilience.integrity.sentinel_action must be log | "
+            f"quarantine | abort, got {action!r}")
+    verify = integ.get("verify_checkpoints")
+    if verify is not None and not isinstance(verify, bool):
+        raise ValueError(
+            f"Resilience.integrity.verify_checkpoints must be a bool, "
+            f"got {verify!r}")
     return config
 
 

@@ -6,6 +6,13 @@ downloading at most once. Downloads stream to a temp file and rename
 atomically, so concurrent processes never see partial artifacts. In
 air-gapped environments http(s) fetches fail loudly with the cache path to
 pre-populate.
+
+Content integrity (docs/resilience.md "Integrity"): an optional expected
+``sha256`` per artifact is verified after every download AND on cache
+hits — previously only the cache *key* was hashed, never the content, so
+a bit-rotted cache entry or a tampered mirror fed the tokenizer silently.
+A mismatch retries the download once through the existing policy (a
+truncated transfer is transient-shaped), then fails fatal.
 """
 
 from __future__ import annotations
@@ -34,13 +41,41 @@ def cache_dir() -> str:
     return os.environ.get("FLEETX_CACHE", DEFAULT_CACHE)
 
 
-def cached_path(url_or_path: str, sub_dir: str = "") -> str:
-    """→ local file path; downloads http(s) URLs into the cache once."""
+def _sha256_file(path: str) -> str:
+    """Streaming sha256 hex digest of a file."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def cached_path(url_or_path: str, sub_dir: str = "",
+                sha256: str = None) -> str:
+    """→ local file path; downloads http(s) URLs into the cache once.
+
+    ``sha256`` (hex digest) pins the artifact's CONTENT: local files and
+    cache hits are verified before being handed out (a corrupt cache
+    entry is evicted and re-downloaded), and every download is verified
+    after the fetch — one mismatch retries through the policy, a second
+    fails fatal (``_PermanentDownloadError``): re-fetching a mirror that
+    keeps serving wrong bytes only delays the incident report.
+    """
+    expected = sha256.lower() if sha256 else None
     parsed = urllib.parse.urlparse(url_or_path)
     if parsed.scheme in ("", "file"):
         path = parsed.path if parsed.scheme == "file" else url_or_path
         if not os.path.exists(path):
             raise FileNotFoundError(path)
+        if expected:
+            got = _sha256_file(path)
+            if got != expected:
+                raise RuntimeError(
+                    f"sha256 mismatch for local artifact {path}: expected "
+                    f"{expected}, got {got}")
         return path
 
     name = os.path.basename(parsed.path) or "download"
@@ -49,10 +84,22 @@ def cached_path(url_or_path: str, sub_dir: str = "") -> str:
     os.makedirs(target_dir, exist_ok=True)
     target = os.path.join(target_dir, f"{key}_{name}")
     if os.path.exists(target):
-        return target
+        if not expected:
+            return target
+        got = _sha256_file(target)
+        if got == expected:
+            return target
+        # bit-rotted / tampered cache entry: evict and re-download (the
+        # cache key hashes only the URL, never the content)
+        logger.warning("cached artifact %s fails sha256 verification "
+                       "(expected %s, got %s) — evicting and "
+                       "re-downloading", target, expected, got)
+        get_registry().counter("download_checksum_mismatches").inc()
+        os.remove(target)
 
     tmp = target + f".tmp.{os.getpid()}"
     logger.info("downloading %s -> %s", url_or_path, target)
+    checksum_failures = [0]
 
     def _fetch_once():
         # raises OSError subclasses (URLError, timeouts, disk errors) —
@@ -62,6 +109,23 @@ def cached_path(url_or_path: str, sub_dir: str = "") -> str:
             with urllib.request.urlopen(url_or_path, timeout=60) as resp, \
                     open(tmp, "wb") as out:
                 shutil.copyfileobj(resp, out)
+            if expected:
+                got = _sha256_file(tmp)
+                if got != expected:
+                    checksum_failures[0] += 1
+                    get_registry().counter(
+                        "download_checksum_mismatches").inc()
+                    if checksum_failures[0] > 1:
+                        # the source keeps serving wrong bytes: fatal —
+                        # this is corruption or tampering, not a blip
+                        raise _PermanentDownloadError(
+                            f"sha256 mismatch for {url_or_path} after "
+                            f"retry: expected {expected}, got {got}")
+                    # first mismatch: transient-shaped (truncated
+                    # transfer), retried once via the policy
+                    raise OSError(
+                        f"sha256 mismatch for {url_or_path}: expected "
+                        f"{expected}, got {got}")
             os.replace(tmp, target)
         except urllib.error.HTTPError as e:
             if 400 <= e.code < 500 and e.code != 429:

@@ -61,6 +61,11 @@ def main() -> int:
     parser.add_argument("--uneven", action="store_true",
                         help="rank 1 gets one batch fewer (dry-stream "
                              "exhaustion drill: the exit must be voted)")
+    parser.add_argument("--sdc-every", type=int, default=0,
+                        help="Resilience.integrity.sentinel_every (SDC "
+                             "sentinel drills)")
+    parser.add_argument("--sdc-action", default="log",
+                        help="Resilience.integrity.sentinel_action")
     args = parser.parse_args()
 
     _sanitize_env()
@@ -99,6 +104,9 @@ def main() -> int:
         res_cfg["guard"] = {"enable": True, "nonfinite_action": "rollback",
                             "nonfinite_streak": 2, "max_rollbacks": 1,
                             "skip_nonfinite_update": False}
+    if args.sdc_every:
+        res_cfg["integrity"] = {"sentinel_every": args.sdc_every,
+                                "sentinel_action": args.sdc_action}
     cfg["Resilience"] = res_cfg
 
     mesh = build_mesh({}, devices=jax.local_devices()[:1])
@@ -146,6 +154,13 @@ def main() -> int:
     status["rollbacks"] = reg.counter("rollbacks_total").value
     status["preemption_exits"] = reg.counter("preemption_exits").value
     status["ckpt_latest"] = ckpt_lib.latest_step(eng.output_dir)
+    status["ckpt_completed"] = ckpt_lib.completed_steps(eng.output_dir)
+    # state-integrity evidence (docs/resilience.md "Integrity"): the gang
+    # drills assert the detectors fired on the right ranks
+    for key in ("sdc_checks_total", "sdc_replay_mismatches",
+                "sdc_fingerprint_mismatches", "ckpt_verify_failed",
+                "ckpt_verify_fallbacks", "ckpt_commit_aborts"):
+        status[key] = reg.counter(key).value
     path = args.status.format(rank=rank)
     with open(f"{path}.tmp", "w") as f:
         json.dump(status, f)

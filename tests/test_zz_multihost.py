@@ -819,6 +819,10 @@ def _worker_cmd(out_dir, status_tpl, steps, seed, **kw):
         cmd += ["--guard-rollback"]
     if kw.get("uneven"):
         cmd += ["--uneven"]
+    if kw.get("sdc_every"):
+        cmd += ["--sdc-every", str(kw["sdc_every"])]
+    if kw.get("sdc_action"):
+        cmd += ["--sdc-action", kw["sdc_action"]]
     return cmd
 
 
@@ -927,6 +931,59 @@ def test_gang_uneven_stream_exhaustion_is_collective(tmp_path):
         assert st["exit"] == "completed", st
         assert st["final_step"] == 4, st  # the short rank's count, on BOTH
         assert len(st["losses"]) == 4, st
+
+
+@needs_gang
+def test_gang_corrupt_shard_aborts_commit_on_both_ranks(tmp_path):
+    """``corrupt_ckpt_at`` on ONE rank (sticky across write retries, so
+    the read-back verification genuinely exhausts the policy): that
+    rank's failed digest vote must abort the two-phase commit on BOTH
+    ranks — no rank publishes a completion marker for step 2 — while the
+    uncorrupted step-4 save and the run itself complete normally."""
+    out = tmp_path / "ckpt"
+    status = tmp_path / "status_{rank}.json"
+    rc, _, err = _supervise(
+        ["--num-procs", "2", "--max-restart", "0", "--preemption-code", "75"],
+        _worker_cmd(out, status, 4, 23, save_steps=2,
+                    faults="corrupt_ckpt_at=2,only_rank=1"),
+        timeout_s=240)
+    assert rc == 0, err[-3000:]  # an aborted commit never kills training
+    sts = _statuses(status)
+    for rank, st in sts.items():
+        assert st["exit"] == "completed", st
+        assert st["final_step"] == 4, st
+        # step 2 was never marked complete on EITHER rank (one corrupt
+        # shard means no checkpoint anywhere); step 4 committed cleanly
+        assert st["ckpt_completed"] == [4], st
+        assert st["ckpt_commit_aborts"] >= 1, st
+    assert sts[1]["ckpt_verify_failed"] >= 1  # the corrupt rank's evidence
+    assert sts[0]["ckpt_verify_failed"] == 0  # the healthy rank's shard
+
+
+@needs_gang
+def test_gang_bitflip_on_one_rank_trips_cross_replica_fingerprint(tmp_path):
+    """``bitflip_param_at`` on ONE rank (a silent HBM fault): the SDC
+    sentinel's cross-replica param fingerprint census must diverge and
+    BOTH ranks must record the mismatch (the census is shared), with
+    ``sentinel_action: log`` keeping the run alive for post-mortem."""
+    out = tmp_path / "ckpt"
+    status = tmp_path / "status_{rank}.json"
+    rc, _, err = _supervise(
+        ["--num-procs", "2", "--max-restart", "0", "--preemption-code", "75"],
+        _worker_cmd(out, status, 4, 27, sdc_every=1,
+                    faults="bitflip_param_at=2,only_rank=1"),
+        timeout_s=240)
+    assert rc == 0, err[-3000:]
+    sts = _statuses(status)
+    for rank, st in sts.items():
+        assert st["exit"] == "completed", st
+        assert st["sdc_checks_total"] >= 3, st
+        # the flip lands after step 2; every later sentinel round sees
+        # the replicas' fingerprints diverge — on BOTH ranks
+        assert st["sdc_fingerprint_mismatches"] >= 1, st
+        # the flip happened BETWEEN steps, so each rank's replay is
+        # self-consistent: only the cross-replica probe fires
+        assert st["sdc_replay_mismatches"] == 0, st
 
 
 @needs_gang

@@ -23,6 +23,13 @@ checkpoint-resume works. This wrapper owns the full process lifecycle:
   crash-restart loop on a machine that is going away. Re-invoking the same
   command later IS the gang restart: auto-resume picks up the emergency
   checkpoint on every rank.
+- **preflight** (``--preflight``): before forming the gang, run a short
+  compute+digest self-test per member (``python -m
+  fleetx_tpu.resilience.integrity --selftest`` in a child process — this
+  supervisor itself stays stdlib-only) and REFUSE to launch with a
+  failing host, reporting which one (exit 41). A host that computes or
+  remembers wrong would otherwise join the gang and corrupt every
+  replica-collective decision silently.
 
 Usage (what ``projects/*.sh`` invoke)::
 
@@ -44,6 +51,10 @@ import time
 #: with --preemption-code; match it in Resilience.preemption.exit_code
 #: when you want a supervisor to distinguish preemption from success)
 PREEMPTION_EXIT_CODE = 75
+
+#: exit code for a refused launch: a gang member failed its preflight
+#: compute+digest self-test (distinct from every trainer/crash code)
+PREFLIGHT_EXIT_CODE = 41
 
 
 def _free_port() -> int:
@@ -118,6 +129,38 @@ class Gang:
         return [p.returncode for p in self.procs]
 
 
+def _preflight(num_procs: int, timeout: float) -> list:
+    """Run the per-member compute+digest self-test; returns failures as
+    ``(member, why, output_tail)`` tuples (empty = all hosts healthy).
+
+    Each member gets its own child process running the integrity
+    module's ``--selftest`` (the supervisor never imports the jax-loaded
+    package itself); ``FLEETX_PREFLIGHT_MEMBER`` tells the child which
+    gang slot it is probing, so a multi-host launcher wrapping this
+    supervisor can map a failure back to a machine."""
+    procs = []
+    for member in range(num_procs):
+        env = dict(os.environ, FLEETX_PREFLIGHT_MEMBER=str(member))
+        procs.append((member, subprocess.Popen(
+            [sys.executable, "-m", "fleetx_tpu.resilience.integrity",
+             "--selftest"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)))
+    failures = []
+    for member, proc in procs:
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            failures.append((member, "timeout", (out or "")[-500:]))
+            continue
+        if proc.returncode != 0:
+            failures.append((member, f"rc={proc.returncode}",
+                             (out or "")[-500:]))
+    return failures
+
+
 def main(argv=None) -> int:
     """Supervisor entry point; returns the process exit code."""
     parser = argparse.ArgumentParser(description="fleetx gang supervisor")
@@ -136,6 +179,13 @@ def main(argv=None) -> int:
                         help="exit code treated as a clean preemption stop "
                              "(never restarted); match "
                              "Resilience.preemption.exit_code")
+    parser.add_argument("--preflight", action="store_true",
+                        help="run a compute+digest self-test per member "
+                             "BEFORE forming the gang; refuse to launch "
+                             f"(exit {PREFLIGHT_EXIT_CODE}) with a failing "
+                             "host, naming it")
+    parser.add_argument("--preflight-timeout", type=float, default=120.0,
+                        help="seconds each preflight self-test may take")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the training command")
     args = parser.parse_args(argv)
@@ -143,6 +193,19 @@ def main(argv=None) -> int:
     if not cmd:
         parser.error("no command given (expected: -- python tools/train.py ...)")
     clean_codes = {0, args.preemption_code}
+
+    if args.preflight:
+        failures = _preflight(args.num_procs, args.preflight_timeout)
+        if failures:
+            for member, why, tail in failures:
+                print(f"[supervise] preflight FAILED for gang member "
+                      f"{member} ({why}): {tail}", file=sys.stderr)
+            print(f"[supervise] refusing to launch: {len(failures)} of "
+                  f"{args.num_procs} members failed preflight",
+                  file=sys.stderr)
+            return PREFLIGHT_EXIT_CODE
+        print(f"[supervise] preflight passed on all {args.num_procs} "
+              f"members", file=sys.stderr)
 
     gang = Gang(cmd, args.num_procs)
     forwarded = {"sig": None}
