@@ -306,6 +306,18 @@ def _bench_impl() -> dict:
         "zero_stage": engine.sharding_stage,
         "grad_bytes_sharded": int(
             engine.obs.registry.gauge("grad_bytes_sharded").value or 0),
+        # gang observability evidence (docs/observability.md "Multi-host"):
+        # mean milliseconds spent waiting inside coordination agreements
+        # (0.0 on single-process runs — the LocalCoordinator issues none),
+        # this rank's rolling arrival skew, and whether the crash flight
+        # recorder was armed — so BENCH_*.json trajectories capture
+        # coordination overhead from this PR on
+        "barrier_wait_ms": round(
+            engine.obs.registry.histogram("barrier_wait_ms")
+            .summary().get("mean") or 0.0, 3),
+        "rank_skew": round(
+            float(engine.obs.registry.gauge("rank_skew").value or 0.0), 6),
+        "flight_recorder": engine.obs.flight is not None,
         # resilience counters (docs/resilience.md): all-zero on a healthy
         # run; fit_step_time_s vs step_time_s bounds the guard/watchdog
         # overhead since both run the same compiled step

@@ -37,13 +37,13 @@ from flax.core import meta
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fleetx_tpu.core import checkpoint as ckpt_lib
-from fleetx_tpu.observability import Observability
+from fleetx_tpu.observability import Observability, flight
 from fleetx_tpu.observability.trace import ProfilerWindow
 from fleetx_tpu.parallel.mesh import build_mesh
 from fleetx_tpu.parallel.sharding import (make_axis_rules, zero_grad_specs,
                                           zero_sharding)
 from fleetx_tpu.resilience import Resilience, TrainingAborted, coordination
-from fleetx_tpu.utils.log import logger
+from fleetx_tpu.utils.log import logger, set_rank_context
 
 
 class ScalerState(struct.PyTreeNode):
@@ -142,6 +142,9 @@ class EagerEngine(BasicEngine):
         # local no-op on single-process runs, KV-store agreement on pods —
         # every recovery decision below routes through it
         self.coord = coordination.get_coordinator()
+        # interleaved gang logs are unattributable without a rank tag;
+        # single-process output stays byte-identical (empty prefix)
+        set_rank_context(self.coord.rank, self.coord.world)
         # per-rank checkpoint directories (host-local SSDs / CPU-mesh test
         # gangs): each process owns <output_dir>/rank_<i> outright and the
         # checkpoint layer switches to the host-local codec
@@ -367,6 +370,11 @@ class EagerEngine(BasicEngine):
             # mesh.size, not device_count(): the run only uses (and its
             # throughput only reflects) the mesh's devices
             self.obs.init_derived(fpt, self.mesh.size)
+            if self.obs.gang_enabled and self.coord.world > 1:
+                # straggler skew (docs/observability.md "Multi-host"):
+                # every coordination agreement's arrival census feeds the
+                # rolling per-rank skew estimate from here on
+                self.obs.install_arrival_hook()
         if self.ckpt_dir:
             self.load(self.ckpt_dir)
         return self.state
@@ -686,6 +694,7 @@ class EagerEngine(BasicEngine):
                     f"(this rank: {fp})")
         if not (mismatch or fp_mismatch):
             return
+        flight.note("sdc", "mismatch", step=int(step), evidence=evidence)
         msg = (f"SDC sentinel tripped at step {step}: "
                + "; ".join(evidence))
         if res.sentinel_action == "abort":
@@ -902,11 +911,34 @@ class EagerEngine(BasicEngine):
 
         with self._ctx(), contextlib.ExitStack() as cleanup:
             cleanup.callback(close_stream)
+
+            def _flight_on_crash(exc_type, exc, tb):
+                """Dump the flight ring on any abnormal fit exit — the
+                per-rank record of what this process was doing in its
+                final seconds (``tools/postmortem.py`` merges them).
+                ``SystemExit`` is the graceful preemption path, which
+                dumps for itself with an honest reason."""
+                if exc_type is not None and \
+                        not issubclass(exc_type, SystemExit):
+                    flight.note("crash", exc_type.__name__,
+                                error=str(exc)[:300])
+                    flight.dump(f"crash:{exc_type.__name__}")
+                return False  # never suppress the exception
+
+            cleanup.push(_flight_on_crash)
             if res.preemption is not None:
                 # scoped install: previous SIGTERM/SIGINT handlers restored
                 # on every fit exit path
                 cleanup.enter_context(res.preemption.installed())
-            watchdog = res.make_watchdog(on_stall=self.obs.flush)
+
+            def _on_stall():
+                """Watchdog stall: durable-ize telemetry AND the flight
+                ring — a hung run's last evidence before a possible
+                action:abort kill."""
+                self.obs.flush()
+                self.obs.flight_dump("watchdog_stall")
+
+            watchdog = res.make_watchdog(on_stall=_on_stall)
             if watchdog is not None:
                 watchdog.start()
                 cleanup.callback(watchdog.stop)
@@ -918,6 +950,12 @@ class EagerEngine(BasicEngine):
             # flow unilaterally — the peers would hang in their next
             # collective; every exit happens on an agreed vote
             gang_loop = res.enabled and self.coord.world > 1
+            # gang metric aggregation (docs/observability.md "Multi-host"):
+            # window snapshots piggyback on the loop-control vote — no new
+            # rendezvous — and rank 0 merges them into gang-scoped records
+            gang_obs = (gang_loop and self.obs.enabled
+                        and self.obs.gang_enabled)
+            self._gang_obs_active = gang_obs
 
             def wd_quiet():
                 """Suspend the stall detector around known-long host phases
@@ -945,6 +983,10 @@ class EagerEngine(BasicEngine):
                         self.save()
                         ckpt_lib.finalize_async_saves()
                 res.registry.counter("preemption_exits").inc()
+                # the one CLEAN dump: a gang post-mortem needs every
+                # rank's flight file, survivors included
+                flight.note("preemption", "exit", step=int(step))
+                self.obs.flight_dump("preemption")
                 self.obs.flush()
                 raise SystemExit(res.preemption_exit_code)
 
@@ -1013,6 +1055,7 @@ class EagerEngine(BasicEngine):
                 res.registry.counter("rollbacks_total").inc()
                 if res.guard is not None:
                     res.guard.note_rollback()
+                flight.note("rollback", "restored", step=int(restored))
                 logger.warning("rolled back to checkpoint step %d", restored)
                 # no rank re-enters the step loop until every peer has
                 # finished restore + rewind — an early rank would dispatch
@@ -1092,11 +1135,19 @@ class EagerEngine(BasicEngine):
                         # loop-control flag: any rank's SIGTERM latches
                         # preemption everywhere (the gang emergency-saves
                         # the same step); any rank's dry stream ends the
-                        # run everywhere
-                        flags = self.coord.all_gather(
-                            "loop_flags",
-                            {"preempt": bool(res.preempted),
-                             "done": stream_done}).values()
+                        # run everywhere. Gang aggregation piggybacks the
+                        # pending window snapshots on the SAME vote — the
+                        # cross-rank metric path adds no rendezvous.
+                        payload = {"preempt": bool(res.preempted),
+                                   "done": stream_done}
+                        if gang_obs:
+                            payload["obs"] = self.obs.gang_take_pending()
+                        votes = self.coord.all_gather("loop_flags", payload)
+                        flags = votes.values()
+                        if gang_obs and self.coord.rank == 0:
+                            # merge BEFORE acting on the flags so the final
+                            # windows are emitted even on the exit vote
+                            self.obs.gang_merge_emit(votes)
                         if any(f["preempt"] for f in flags):
                             if res.preemption is not None:
                                 res.preemption.latch()
@@ -1231,6 +1282,9 @@ class EagerEngine(BasicEngine):
                             decision = coordination.most_severe(
                                 self.coord.all_gather(
                                     "guard_decision", decision).values())
+                        if decision is not None:
+                            flight.note("guard", str(decision),
+                                        step=int(step), loss=loss)
                         if decision == "rollback":
                             with wd_quiet():
                                 (batch_iter, prefetcher), step = \
@@ -1342,6 +1396,15 @@ class EagerEngine(BasicEngine):
             record["grad_norm"] = float(metrics["grad_norm"])
         if "loss_scale" in metrics:
             record["loss_scale"] = float(metrics["loss_scale"])
+        if getattr(self, "_gang_obs_active", False):
+            # rolling straggler skew (seconds behind the median arrival at
+            # coordination rendezvous points) rides every window record
+            skew = obs.own_skew()
+            if skew is not None:
+                record["rank_skew"] = skew
+            # queue the window for the next loop-control vote: rank 0
+            # merges every rank's snapshots into the gang-scoped stream
+            obs.gang_stash(record)
         obs.registry.gauge("loss").set(record["loss"])
         obs.registry.histogram("step_time").record(record["step_time"])
         obs.emit(record)

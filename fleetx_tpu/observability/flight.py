@@ -1,0 +1,159 @@
+"""Crash flight recorder: a bounded ring of the run's last moments.
+
+A crashed gang leaves nothing behind but exit codes: the 600 s
+``CoordinationTimeout`` census says WHO was missing, never WHAT each rank
+was doing in its final seconds. ``FlightRecorder`` keeps a bounded
+in-memory ring of recent events — spans, per-window metric snapshots, and
+resilience events (votes, guard decisions, rollbacks, commit outcomes,
+coordination timeouts) — and dumps it atomically as
+``flight_rank<i>.json`` when the run dies:
+
+- watchdog stall (local heartbeat or gang-barrier timeout),
+- ``TrainingAborted`` / any unhandled crash in ``fit``,
+- graceful preemption exit (the one *clean* dump, for symmetry: a gang
+  post-mortem needs every rank's file, including the survivors').
+
+``tools/postmortem.py`` merges N flight files into one timeline and names
+the first-diverging rank. ``tools/supervise.py`` hands each gang member a
+per-generation ``FLEETX_FLIGHT_DIR`` so a restarted gang never overwrites
+the previous generation's evidence.
+
+Everything here is stdlib-only and recording is a deque append under a
+lock — cheap enough to leave on whenever telemetry is on. The module-level
+``install``/``note``/``dump`` helpers let deep layers (coordination
+timeouts, the gang watchdog) contribute events without config plumbing,
+mirroring ``resilience/faults.py``'s active-plan pattern.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["FlightRecorder", "install", "get_recorder", "note", "dump",
+           "ENV_DIR", "DEFAULT_CAPACITY"]
+
+#: per-rank dump directory override — ``tools/supervise.py`` sets this to a
+#: per-generation, per-rank path so restart evidence never collides
+ENV_DIR = "FLEETX_FLIGHT_DIR"
+
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Bounded event ring with an atomic JSON dump.
+
+    One instance per process (the engine installs it module-wide); the
+    ring holds the newest ``capacity`` events, so a long healthy run costs
+    a fixed amount of memory and the dump always shows the final window of
+    activity, not the first.
+    """
+
+    def __init__(self, out_dir: str, rank: int = 0, world: int = 1,
+                 capacity: int = DEFAULT_CAPACITY):
+        self.out_dir = str(out_dir)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.capacity = max(int(capacity), 1)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._recorded = 0  # all-time count (ring eviction is invisible)
+        self.dump_count = 0
+        self.last_reason: Optional[str] = None
+
+    @property
+    def path(self) -> str:
+        """The dump target: ``<out_dir>/flight_rank<rank>.json``."""
+        return os.path.join(self.out_dir, f"flight_rank{self.rank}.json")
+
+    def record(self, kind: str, name: str, **data: Any) -> None:
+        """Append one event (wall-clock stamped; oldest falls off).
+
+        The reserved fields win over ``data``: a caller's ``t``/``kind``/
+        ``name`` keyword must never clobber the timestamp the post-mortem
+        timeline sorts by.
+        """
+        evt = {**data, "t": time.time(), "kind": kind, "name": name}
+        with self._lock:
+            self._ring.append(evt)
+            self._recorded += 1
+
+    def events(self) -> list:
+        """Snapshot of the current ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, reason: str) -> str:
+        """Atomically write the ring as ``flight_rank<i>.json``.
+
+        Re-dumping overwrites: the latest dump carries the most recent
+        events, which is what a post-mortem wants. The write goes through
+        the shared tmp+fsync+``os.replace`` helper so a crash mid-dump can
+        never leave a torn file for ``tools/postmortem.py`` to choke on.
+        """
+        from fleetx_tpu.resilience.integrity import atomic_write
+
+        with self._lock:
+            payload = {
+                "rank": self.rank, "world": self.world,
+                "reason": str(reason), "dumped_at": time.time(),
+                "recorded_total": self._recorded,
+                "capacity": self.capacity,
+                "events": list(self._ring),
+            }
+        os.makedirs(self.out_dir, exist_ok=True)
+        atomic_write(self.path, lambda f: json.dump(payload, f))
+        self.dump_count += 1
+        self.last_reason = str(reason)
+        logger.warning("flight recorder dumped (%s): %s (%d events)",
+                       reason, self.path, len(payload["events"]))
+        return self.path
+
+
+# ---------------------------------------------------------------------------
+# Module-level active recorder (deep layers contribute without plumbing)
+# ---------------------------------------------------------------------------
+
+_recorder: Optional[FlightRecorder] = None
+
+
+def install(recorder: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
+    """Install (or clear, with None) the process-wide recorder; returns
+    the previous one. Engine-scoped like the fault plan: the newest
+    engine's Observability wins."""
+    global _recorder
+    prev = _recorder
+    _recorder = recorder
+    return prev
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    """The active recorder, if any."""
+    return _recorder
+
+
+def note(kind: str, name: str, **data: Any) -> None:
+    """Record one event on the active recorder (no-op when none)."""
+    if _recorder is not None:
+        _recorder.record(kind, name, **data)
+
+
+def dump(reason: str) -> Optional[str]:
+    """Dump the active recorder (no-op when none); returns the path.
+
+    Never raises: a failing flight dump on the crash path must not mask
+    the original exception the post-mortem is for.
+    """
+    if _recorder is None:
+        return None
+    try:
+        return _recorder.dump(reason)
+    except Exception as e:  # noqa: BLE001 — the dump is best-effort
+        logger.error("flight recorder dump failed: %s", e)
+        return None

@@ -273,6 +273,9 @@ class DerivedMetrics:
         self.ewma_alpha = float(ewma_alpha)
         self._ewma: Optional[float] = None
         self._last_stall_total = 0.0
+        # per-rank arrival-skew EWMAs (gang mode): rank → seconds behind
+        # the median arrival at collective rendezvous points
+        self._skew: dict[int, float] = {}
 
     def update(self, step_time: float, global_batch_size: int,
                tokens_per_sample: Optional[int] = None,
@@ -308,3 +311,39 @@ class DerivedMetrics:
             "mfu": mfu(tokens_per_sec, self.flops_per_token,
                        self.peak_flops_per_chip, self.n_devices),
         }
+
+    # -- cross-rank skew (docs/observability.md "Multi-host") ---------------
+    def update_arrivals(self, arrivals: dict) -> None:
+        """Fold one collective rendezvous' arrival census into the rolling
+        per-rank skew estimate.
+
+        ``arrivals`` maps rank → publish wall-clock timestamp at one
+        agreement (``resilience/coordination.py`` feeds these through the
+        ``observability.gang`` arrival hook). Skew is the EWMA of each
+        rank's offset from the *median* arrival: a persistently positive
+        skew names a straggler while the run is still healthy, instead of
+        the post-mortem census a 600 s ``CoordinationTimeout`` yields
+        after the run is already dead.
+        """
+        if not arrivals or len(arrivals) < 2:
+            return
+        ts = sorted(float(t) for t in arrivals.values())
+        mid = len(ts) // 2
+        median = ts[mid] if len(ts) % 2 else (ts[mid - 1] + ts[mid]) / 2.0
+        a = self.ewma_alpha if self.ewma_alpha > 0 else 1.0
+        for rank, t in arrivals.items():
+            skew = float(t) - median
+            prev = self._skew.get(int(rank))
+            self._skew[int(rank)] = (skew if prev is None
+                                     else a * skew + (1.0 - a) * prev)
+
+    def rank_skew(self) -> dict:
+        """rank → rolling seconds behind (+) / ahead (−) of the median."""
+        return dict(self._skew)
+
+    def slowest_rank(self) -> Optional[int]:
+        """The rank with the largest positive skew, or None before any
+        arrival census has been observed."""
+        if not self._skew:
+            return None
+        return max(self._skew, key=lambda r: self._skew[r])

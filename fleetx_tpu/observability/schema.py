@@ -15,6 +15,11 @@ from typing import Any, Iterable, Optional
 _NUM = (int, float)
 _NULLABLE_NUM = (int, float, type(None))
 
+#: version carried by records with cross-rank context (gang mode): plain
+#: single-process records carry no version key and count as version 1 —
+#: ``tools/metrics_report.py`` refuses to mix versions in one report
+SCHEMA_VERSION = 2
+
 # key → (allowed types, required?)
 STEP_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "step": ((int,), True),
@@ -24,12 +29,38 @@ STEP_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "tokens_per_sec": (_NULLABLE_NUM, True),
     "mfu": (_NULLABLE_NUM, True),  # null on chips without a peak table entry
     "step_time_ewma": (_NUM, False),
-    "samples_per_sec": (_NUM, False),
+    "samples_per_sec": (_NULLABLE_NUM, False),
     "data_stall_frac": (_NUM, False),
     "epoch": ((int,), False),
     "lr": (_NUM, False),
     "global_batch_size": ((int,), False),
+    # gang-mode context (docs/observability.md "Multi-host"): per-rank
+    # records carry rank/world/schema_version; rank-0's merged records add
+    # the scope marker, the step-time spread with rank attribution and the
+    # rolling straggler skew
+    "schema_version": ((int,), False),
+    "rank": ((int,), False),
+    "world": ((int,), False),
+    "scope": ((str,), False),
+    "ranks_reported": ((int,), False),
+    "step_time_min": (_NUM, False),
+    "step_time_median": (_NUM, False),
+    "step_time_max": (_NUM, False),
+    "step_time_min_rank": ((int,), False),
+    "step_time_max_rank": ((int,), False),
+    "rank_skew": (_NUM, False),
+    "rank_skew_max": (_NUM, False),
+    "rank_skew_max_rank": ((int,), False),
+    "barrier_wait_ms_mean": (_NUM, False),
+    "barrier_wait_ms_max": (_NUM, False),
+    "barrier_wait_ms_max_rank": ((int,), False),
 }
+
+
+def record_schema_version(record: dict) -> int:
+    """A record's schema version (absent → 1, the pre-gang layout)."""
+    v = record.get("schema_version")
+    return 1 if v is None else int(v)
 
 
 def validate_record(record: Any) -> list[str]:

@@ -148,11 +148,16 @@ _SINK_TYPES = {
 
 
 def build_sinks(sink_names, output_dir: str,
-                rank0_only: bool = True) -> list[Sink]:
+                rank0_only: bool = True, suffix: str = "") -> list[Sink]:
     """Instantiate sinks under ``output_dir``; non-zero ranks get ``[]``.
 
     Unknown names warn and are skipped — a typo in YAML must not kill a
     multi-hour training run at its first logging window.
+
+    ``suffix`` is inserted before the file extension (gang mode passes
+    ``.rank<i>`` so every rank writes its own ``metrics.rank<i>.jsonl``
+    instead of the rank-0-gated single file — the per-rank inputs
+    ``tools/metrics_report.py`` merges).
     """
     if rank0_only:
         try:
@@ -168,5 +173,8 @@ def build_sinks(sink_names, output_dir: str,
                            name, sorted(_SINK_TYPES))
             continue
         cls, fname = entry
+        if suffix:
+            root, ext = os.path.splitext(fname)
+            fname = f"{root}{suffix}{ext}"
         sinks.append(cls(os.path.join(output_dir, fname)))
     return sinks

@@ -32,6 +32,7 @@ from typing import Any, Optional
 
 import jax
 
+from fleetx_tpu.observability import flight
 from fleetx_tpu.utils.log import logger
 
 
@@ -146,6 +147,15 @@ class span:
         tracer = _active_tracer
         if tracer is not None:
             tracer.add_event(self.name, self._ts * 1e6, dur * 1e6, self.args)
+        # spans are the flight recorder's timeline backbone: a crash dump
+        # shows exactly which phase each rank was in (no-op when no
+        # recorder is installed — one None check). Span args ride NESTED:
+        # span() accepts arbitrary keywords, and a user arg named "kind"
+        # or "t" must not collide with the event's own fields.
+        if flight.get_recorder() is not None:
+            extra = {"args": self.args} if self.args else {}
+            flight.note("span", self.name,
+                        dur_ms=round(dur * 1000.0, 3), **extra)
         return False
 
     def __call__(self, fn):

@@ -38,6 +38,7 @@ import time
 from collections import Counter, defaultdict
 from typing import Any, Dict, Iterable, Optional
 
+from fleetx_tpu.observability import gang as obs_gang
 from fleetx_tpu.utils.log import logger
 
 __all__ = ["CoordinationTimeout", "LocalCoordinator", "DistributedCoordinator",
@@ -233,18 +234,27 @@ class DistributedCoordinator:
 
         Deterministic across ranks: each rank publishes exactly once per
         generation, so all ranks decode the identical census.
+
+        Every payload rides in a ``{"__v": value, "__t": publish-time}``
+        envelope: the timestamps are the collective-wait evidence
+        (docs/observability.md "Multi-host") — the entry-to-completion
+        wait lands in the ``barrier_wait_ms`` histogram and the per-rank
+        arrival census feeds the rolling straggler-skew estimate, so a
+        slow rank is *named* while the run is healthy instead of
+        surfacing as a post-mortem ``CoordinationTimeout`` census.
         """
         gen = self._gen[name]
         self._gen[name] += 1
         prefix = self._prefix(name, gen)
-        self._client.key_value_set(f"{prefix}/{self.rank}",
-                                   json.dumps(value))
+        t_entry = time.monotonic()
+        own = json.dumps({"__v": value, "__t": time.time()})
+        self._client.key_value_set(f"{prefix}/{self.rank}", own)
         timeout = _timeout_s if timeout_s is None else float(timeout_s)
         deadline = time.monotonic() + timeout
         # the per-peer blocking gets already return every payload (own
         # value is known locally) — a success needs no extra directory
         # read, which matters on the once-per-step loop_flags vote
-        payloads = {self.rank: json.dumps(value)}
+        payloads = {self.rank: own}
         for peer in range(self.world):
             if peer == self.rank:
                 continue
@@ -254,11 +264,21 @@ class DistributedCoordinator:
             if payload is None:
                 arrived = self._arrived(prefix)
                 missing = set(range(self.world)) - set(arrived)
+                obs_gang.note_timeout(f"{name}#{gen}", arrived, missing)
                 raise CoordinationTimeout(f"{name}#{gen}", arrived, missing,
                                           timeout)
             payloads[peer] = payload
         self._gc_previous(name, gen)
-        return {r: json.loads(p) for r, p in payloads.items()}
+        values: Dict[int, Any] = {}
+        arrivals: Dict[int, float] = {}
+        for rank, payload in payloads.items():
+            decoded = json.loads(payload)
+            values[rank] = decoded["__v"]
+            arrivals[rank] = float(decoded["__t"])
+        obs_gang.note_agreement(name, time.monotonic() - t_entry,
+                                arrivals=arrivals, rank=self.rank,
+                                world=self.world)
+        return values
 
     def barrier(self, name: str, timeout_s: Optional[float] = None) -> None:
         """Timed rendezvous; :class:`CoordinationTimeout` names stragglers."""
@@ -273,12 +293,18 @@ class DistributedCoordinator:
         if self.rank == 0:
             self._client.key_value_set(key, json.dumps(value))
             return value
+        t_entry = time.monotonic()
         timeout = _timeout_s if timeout_s is None else float(timeout_s)
         payload = self._await_key(key, timeout)
         if payload is None:
             # the census is the set of PUBLISHED keys; a broadcast waiter
             # never writes one, so it must not report itself as arrived
+            obs_gang.note_timeout(f"{name}#{gen}", [], [0])
             raise CoordinationTimeout(f"{name}#{gen}", [], [0], timeout)
+        # wait histogram only — the one-publisher shape has no arrival
+        # census to feed the skew estimate
+        obs_gang.note_agreement(name, time.monotonic() - t_entry,
+                                rank=self.rank, world=self.world)
         return json.loads(payload)
 
     def any_flag(self, name: str, flag: bool,

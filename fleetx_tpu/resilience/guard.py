@@ -27,6 +27,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+from fleetx_tpu.observability import flight
 from fleetx_tpu.observability.metrics import get_registry
 from fleetx_tpu.utils.log import logger
 
@@ -114,6 +115,10 @@ class TrainingGuard:
             # observation is recorded
             self.registry.counter("nonfinite_skips" if self.skip_active
                                   else "nonfinite_windows").inc()
+            # the flight ring wants the streak's BUILD-UP, not just the
+            # final decision — a crash dump should show the run going bad
+            flight.note("guard", "nonfinite", step=int(step),
+                        streak=self._streak)
             logger.warning("non-finite loss at step %d (streak %d/%d, "
                            "action=%s)", step, self._streak,
                            self.nonfinite_streak, self.nonfinite_action)
@@ -126,6 +131,8 @@ class TrainingGuard:
                 self._observed > self.spike_min_steps and \
                 loss > self.spike_factor * self._ewma:
             self.registry.counter("loss_spikes_total").inc()
+            flight.note("guard", "loss_spike", step=int(step),
+                        loss=float(loss), ewma=float(self._ewma))
             logger.warning("loss spike at step %d: %.4g > %.1fx ewma %.4g "
                            "(action=%s)", step, loss, self.spike_factor,
                            self._ewma, self.spike_action)

@@ -106,6 +106,11 @@ class PreemptionHandler:
         """
         if not self._flag.is_set():
             self._flag.set()
+            # flight evidence from the VOTE path only — never from the
+            # signal handler itself (the ring's lock is not signal-safe)
+            from fleetx_tpu.observability import flight
+
+            flight.note("preemption", "latched", via=str(reason))
             logger.warning("preemption latched via %s — checkpoint-and-exit "
                            "at the next step boundary", reason)
 

@@ -251,6 +251,7 @@ class GangWatchdog:
     def check(self, step: int) -> None:
         """Rendezvous round (every ``sync_steps``-th call); on timeout log
         the straggler set + this rank's stacks, then log or abort."""
+        from fleetx_tpu.observability import flight
         from fleetx_tpu.resilience.coordination import CoordinationTimeout
 
         self._calls += 1
@@ -265,6 +266,11 @@ class GangWatchdog:
                 "straggler ranks %s (arrived: %s); dumping local stacks\n%s",
                 step, self.timeout_s, e.missing, e.arrived,
                 _format_all_stacks())
+            # the flight ring is this rank's half of the post-mortem the
+            # straggler census starts: dump it BEFORE a possible abort
+            flight.note("watchdog", "gang_stall", step=int(step),
+                        missing=e.missing, arrived=e.arrived)
+            flight.dump("gang_watchdog_stall")
             if self.action == "abort":
                 logger.error("gang watchdog: aborting process (exit %d)",
                              ABORT_EXIT_CODE)

@@ -238,13 +238,24 @@ def process_engine_config(config: AttrDict) -> AttrDict:
 def process_observability_config(config: AttrDict) -> AttrDict:
     """Ensure the ``Observability`` block exists (docs/observability.md).
 
-    Only ``enable`` (opt-in, default False — telemetry never surprises a
-    recipe) is materialised here so ``print_config`` shows the switch; the
+    Only ``enable`` and ``gang`` (both opt-in, default False — telemetry
+    never surprises a recipe, and gang mode changes sink file naming) are
+    materialised here so ``print_config`` shows the switches; the
     per-knob defaults live in ONE place, ``observability.Observability``,
     which engines also reach without ``get_config``.
+
+    The flight-recorder capacity gets eager validation: a zero/negative
+    ring would silently record nothing, discovered only at the crash the
+    recorder exists for.
     """
     obs = config.setdefault("Observability", AttrDict())
     obs.setdefault("enable", False)
+    obs.setdefault("gang", False)
+    flight = obs.get("flight") or {}
+    capacity = flight.get("capacity")
+    if capacity is not None and int(capacity) <= 0:
+        raise ValueError(
+            f"Observability.flight.capacity must be > 0, got {capacity!r}")
     return config
 
 

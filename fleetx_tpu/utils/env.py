@@ -17,7 +17,7 @@ import os
 
 import jax
 
-from fleetx_tpu.utils.log import logger
+from fleetx_tpu.utils.log import logger, set_rank_context
 
 #: tri-state: None = never called, True/False = first call's verdict
 _initialized: bool | None = None
@@ -58,6 +58,10 @@ def init_dist_env(coordinator_address: str | None = None,
             process_id=process_id if process_id is not None
             else (int(os.environ["FLEETX_PROCESS_ID"]) if "FLEETX_PROCESS_ID" in os.environ else None),
         )
+        # tag every later log record with this process's rank — the first
+        # thing an interleaved gang log needs (utils/log.py; single-process
+        # worlds keep the prefix empty and the output byte-identical)
+        set_rank_context(jax.process_index(), jax.process_count())
         logger.info("jax.distributed initialized: process %d/%d",
                     jax.process_index(), jax.process_count())
     _initialized = distributed

@@ -27,6 +27,23 @@ _COLORS = {
 }
 _RESET = "\033[0m"
 
+# Rank attribution for multi-process gangs: interleaved gang logs are
+# unattributable without it. Empty (the default, and always for world 1)
+# keeps single-process output byte-identical.
+_rank_prefix = ""
+
+
+def set_rank_context(rank: int, world: int) -> None:
+    """Prefix every record with ``[r<rank>/<world>]`` when ``world > 1``.
+
+    Called by ``utils/env.py:init_dist_env`` and the engine once the gang
+    size is known; idempotent, and ``world <= 1`` clears the prefix so
+    single-process runs (and tests toggling it) emit the exact pre-gang
+    format.
+    """
+    global _rank_prefix
+    _rank_prefix = f"[r{int(rank)}/{int(world)}] " if int(world) > 1 else ""
+
 
 class _ColorFormatter(logging.Formatter):
     """Colorize per the HANDLER's stream, not ``sys.stderr`` globally.
@@ -54,8 +71,8 @@ class _ColorFormatter(logging.Formatter):
             return False
 
     def format(self, record: logging.LogRecord) -> str:
-        """Inject the level color codes into the record."""
-        msg = super().format(record)
+        """Inject the rank prefix and the level color codes."""
+        msg = _rank_prefix + super().format(record)
         if self._colorize():
             color = _COLORS.get(record.levelname, "")
             return f"{color}{msg}{_RESET}"

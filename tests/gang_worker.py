@@ -66,6 +66,14 @@ def main() -> int:
                              "sentinel drills)")
     parser.add_argument("--sdc-action", default="log",
                         help="Resilience.integrity.sentinel_action")
+    parser.add_argument("--obs", action="store_true",
+                        help="enable Observability gang mode: per-rank "
+                             "jsonl sinks, rank-0 merged gang records, "
+                             "crash flight recorder")
+    parser.add_argument("--coord-timeout", type=float, default=120.0,
+                        help="Resilience.coordination.timeout_s (crash "
+                             "drills shrink it so a dead peer surfaces "
+                             "inside the test budget)")
     args = parser.parse_args()
 
     _sanitize_env()
@@ -95,11 +103,19 @@ def main() -> int:
     res_cfg = {
         "enable": True,
         "retry": {"max_attempts": 2, "backoff_s": 0.0, "jitter": 0.0},
-        "coordination": {"timeout_s": 120.0},
+        "coordination": {"timeout_s": args.coord_timeout},
         "preemption": {"enable": True, "save_on_exit": True,
                        "exit_code": args.exit_code, "sync_every": 1},
         "guard": {"enable": False},
     }
+    if args.obs:
+        # gang observability (docs/observability.md "Multi-host"): every
+        # rank writes metrics.rank<i>.jsonl under its own telemetry dir,
+        # rank 0 additionally merges the gang stream, and the crash
+        # flight recorder arms (FLEETX_FLIGHT_DIR from the supervisor)
+        cfg["Observability"] = {"enable": True, "gang": True,
+                                "sinks": ["jsonl"],
+                                "trace": {"enable": False}}
     if args.guard_rollback:
         res_cfg["guard"] = {"enable": True, "nonfinite_action": "rollback",
                             "nonfinite_streak": 2, "max_rollbacks": 1,
@@ -161,6 +177,15 @@ def main() -> int:
                 "sdc_fingerprint_mismatches", "ckpt_verify_failed",
                 "ckpt_verify_fallbacks", "ckpt_commit_aborts"):
         status[key] = reg.counter(key).value
+    # gang-observability evidence: collective-wait histogram population,
+    # the rolling straggler skew, and where the flight ring would dump
+    status["coord_agreements"] = reg.counter("coord_agreements_total").value
+    status["barrier_waits"] = reg.histogram("barrier_wait_ms") \
+        .summary().get("count", 0)
+    status["rank_skew"] = reg.gauge("rank_skew").value
+    status["telemetry_dir"] = eng.obs.output_dir if eng.obs.enabled else None
+    status["flight_path"] = (eng.obs.flight.path
+                             if eng.obs.flight is not None else None)
     path = args.status.format(rank=rank)
     with open(f"{path}.tmp", "w") as f:
         json.dump(status, f)

@@ -443,3 +443,353 @@ def test_inference_latency_histogram(tmp_path, devices8):
     warm = eng.latency_summary()
     assert warm["count"] == 2
     assert {"p50", "p95", "p99"} <= set(warm)
+
+
+# ----------------------------------------------- flight recorder (gang obs)
+
+def test_flight_recorder_ring_bounds_and_atomic_dump(tmp_path):
+    from fleetx_tpu.observability import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path), rank=3, world=4, capacity=16)
+    for i in range(40):
+        rec.record("span", f"e{i}", i=i)
+    events = rec.events()
+    assert len(events) == 16                       # bounded ring
+    assert events[0]["name"] == "e24"              # oldest fell off
+    assert events[-1]["name"] == "e39"
+
+    path = rec.dump("unit-test")
+    assert path.endswith("flight_rank3.json")
+    data = json.load(open(path))
+    assert data["rank"] == 3 and data["world"] == 4
+    assert data["reason"] == "unit-test"
+    assert data["recorded_total"] == 40 and len(data["events"]) == 16
+    # atomic publish: nothing but the dump itself on disk
+    assert os.listdir(tmp_path) == ["flight_rank3.json"]
+
+    rec.record("vote", "later")
+    rec.dump("second")                             # overwrite, newest wins
+    data2 = json.load(open(path))
+    assert data2["reason"] == "second"
+    assert data2["events"][-1]["name"] == "later"
+    assert rec.dump_count == 2
+
+
+def test_flight_module_helpers_noop_without_recorder(tmp_path):
+    from fleetx_tpu.observability import FlightRecorder, flight
+
+    flight.install(None)
+    flight.note("k", "n")                          # silent no-op
+    assert flight.dump("x") is None
+
+    rec = FlightRecorder(str(tmp_path), rank=0, world=2)
+    prev = flight.install(rec)
+    try:
+        flight.note("vote", "loop_flags", round=1)
+        assert flight.dump("r") == rec.path
+        events = json.load(open(rec.path))["events"]
+        assert events[0]["kind"] == "vote" and events[0]["round"] == 1
+    finally:
+        flight.install(prev)
+
+
+def test_span_feeds_flight_ring(tmp_path):
+    from fleetx_tpu.observability import FlightRecorder, flight
+
+    rec = FlightRecorder(str(tmp_path))
+    prev = flight.install(rec)
+    try:
+        with span("phase_x", step=2):
+            pass
+        # span args that collide with event fields must stay harmless:
+        # they ride nested under "args", never clobbering the timestamp
+        with span("phase_y", kind="full", t=0):
+            pass
+    finally:
+        flight.install(prev)
+    events = rec.events()
+    assert events[0]["kind"] == "span"
+    assert events[0]["name"] == "phase_x" and events[0]["args"] == {"step": 2}
+    assert events[0]["dur_ms"] >= 0.0
+    assert events[1]["kind"] == "span" and events[1]["t"] > 1e9
+    assert events[1]["args"] == {"kind": "full", "t": 0}
+
+
+# -------------------------------------------------- rank skew (gang obs)
+
+def test_derived_metrics_rank_skew_ewma():
+    d = DerivedMetrics(ewma_alpha=0.5)
+    assert d.rank_skew() == {} and d.slowest_rank() is None
+    d.update_arrivals({0: 100.0, 1: 100.5})
+    # two-rank median is the midpoint: skew splits ±0.25
+    assert d.rank_skew()[1] == pytest.approx(0.25)
+    assert d.rank_skew()[0] == pytest.approx(-0.25)
+    d.update_arrivals({0: 200.0, 1: 200.1})
+    assert d.rank_skew()[1] == pytest.approx(0.5 * 0.05 + 0.5 * 0.25)
+    assert d.slowest_rank() == 1
+    # a one-rank census carries no cross-rank information
+    before = d.rank_skew()
+    d.update_arrivals({0: 1.0})
+    assert d.rank_skew() == before
+
+
+# ----------------------------------------------- snapshot merge (gang obs)
+
+def _window_record(step, *, step_time, tps, loss, mfu=None, skew=None):
+    rec = {"ts": 10.0 + step, "step": step, "loss": loss,
+           "step_time": step_time, "tokens_per_sec": tps,
+           "samples_per_sec": tps / 32.0 if tps else None, "mfu": mfu,
+           "global_batch_size": 16}
+    if skew is not None:
+        rec["rank_skew"] = skew
+    return rec
+
+
+def test_merge_snapshots_sums_counters_and_attributes_extremes():
+    from fleetx_tpu.observability import gang
+
+    reg0, reg1 = MetricsRegistry(), MetricsRegistry()
+    reg0.counter("rollbacks_total").inc(1)
+    reg1.counter("rollbacks_total").inc(2)
+    reg1.counter("nonfinite_skips").inc(5)
+    reg0.histogram("barrier_wait_ms").record(10.0)
+    reg1.histogram("barrier_wait_ms").record(30.0)
+    reg1.histogram("barrier_wait_ms").record(50.0)
+    s0 = gang.snapshot(_window_record(5, step_time=0.1, tps=1000.0,
+                                      loss=2.0, mfu=0.4, skew=-0.01),
+                       reg0, rank=0, window=0)
+    s1 = gang.snapshot(_window_record(5, step_time=0.3, tps=400.0,
+                                      loss=2.5, skew=0.2),
+                       reg1, rank=1, window=0)
+    merged = gang.merge_snapshots({0: [s0], 1: [s1]}, world=2)
+    assert len(merged) == 1
+    m = merged[0]
+    assert m["scope"] == "gang" and m["world"] == 2
+    assert m["ranks_reported"] == 2 and m["schema_version"] == 2
+    # counters summed across ranks
+    assert m["rollbacks_total"] == 3.0
+    assert m["nonfinite_skips"] == 5.0
+    # step-time spread with rank attribution; the slowest rank IS the
+    # fleet's effective rate in a lockstep gang
+    assert m["step_time"] == 0.3
+    assert m["step_time_min"] == 0.1 and m["step_time_max"] == 0.3
+    assert m["step_time_median"] == pytest.approx(0.2)
+    assert m["step_time_min_rank"] == 0 and m["step_time_max_rank"] == 1
+    assert m["tokens_per_sec"] == 400.0
+    assert m["loss"] == pytest.approx(2.25)
+    assert m["mfu"] == 0.4                         # mean of the non-nulls
+    assert m["rank_skew_max"] == 0.2 and m["rank_skew_max_rank"] == 1
+    # wait histograms pooled: count-weighted mean, extreme with its rank
+    assert m["barrier_wait_ms_mean"] == pytest.approx((10 + 30 + 50) / 3)
+    assert m["barrier_wait_ms_max"] == 50.0
+    assert m["barrier_wait_ms_max_rank"] == 1
+    # gang records ride the same schema as step records
+    assert validate_record(m) == [], validate_record(m)
+
+
+def test_merge_snapshots_aligns_windows_and_tolerates_partial():
+    from fleetx_tpu.observability import gang
+
+    reg = MetricsRegistry()
+    snaps = {
+        0: [gang.snapshot(_window_record(1, step_time=0.1, tps=10.0,
+                                         loss=1.0), reg, 0, 0),
+            gang.snapshot(_window_record(2, step_time=0.1, tps=10.0,
+                                         loss=0.9), reg, 0, 1)],
+        1: [gang.snapshot(_window_record(1, step_time=0.2, tps=10.0,
+                                         loss=1.1), reg, 1, 0)],
+    }
+    merged = gang.merge_snapshots(snaps, world=2)
+    assert [m["step"] for m in merged] == [1, 2]   # window order
+    assert merged[0]["ranks_reported"] == 2
+    assert merged[1]["ranks_reported"] == 1        # partial, not dropped
+
+
+# ---------------------------------------------- gang-mode facade behaviour
+
+def test_gang_mode_stamps_records_and_rank_suffixes_sinks(tmp_path):
+    obs = Observability({"enable": True, "gang": True, "sinks": ["jsonl"],
+                         "output_dir": str(tmp_path),
+                         "trace": {"enable": False}})
+    try:
+        assert obs.gang_enabled and obs.flight is not None
+        obs.emit(_window_record(1, step_time=0.1, tps=10.0, loss=1.0))
+        path = tmp_path / "metrics.rank0.jsonl"
+        assert path.exists()                       # rank-suffixed file
+        rec = json.loads(path.read_text().splitlines()[0])
+        assert rec["rank"] == 0 and rec["world"] == 1
+        assert rec["schema_version"] == 2
+        assert validate_record(rec) == []
+        # stash/take cycle: the vote payload drains the pending snapshots
+        obs.gang_stash(rec)
+        pending = obs.gang_take_pending()
+        assert len(pending) == 1 and pending[0]["w"] == 0
+        assert obs.gang_take_pending() == []
+    finally:
+        obs.close()
+    from fleetx_tpu.observability import flight as flight_mod
+    assert flight_mod.get_recorder() is None       # close releases it
+
+
+def test_gang_off_keeps_pre_gang_layout(tmp_path, devices8):
+    """The acceptance pin: with ``Observability.gang`` off, the emitted
+    records carry EXACTLY the pre-gang key set and the pre-gang file
+    names — no rank stamps, no per-rank suffixes, no gang stream."""
+    eng = _obs_engine(tmp_path, devices8[:1], max_steps=2)
+    eng.fit(_batches(2))
+    eng.obs.close()
+    telemetry = tmp_path / "telemetry"
+    names = sorted(os.listdir(telemetry))
+    assert "metrics.jsonl" in names
+    assert not any("rank" in n or "gang" in n for n in names), names
+    pre_gang_keys = {
+        "ts", "step", "epoch", "loss", "step_time", "tokens_per_sec",
+        "mfu", "lr", "global_batch_size", "engine", "step_time_ewma",
+        "samples_per_sec", "data_stall_frac", "grad_norm",
+    }
+    for line in (telemetry / "metrics.jsonl").read_text().splitlines():
+        assert set(json.loads(line)) == pre_gang_keys
+
+
+# ------------------------------------------------ log rank-prefix satellite
+
+def test_log_rank_prefix_only_on_gangs():
+    from fleetx_tpu.utils.log import _ColorFormatter, set_rank_context
+
+    handler = logging.StreamHandler(io.StringIO())
+    fmt = _ColorFormatter("%(message)s", stream=handler)
+    rec = logging.LogRecord("t", logging.INFO, __file__, 1, "hello", (),
+                            None)
+    try:
+        set_rank_context(0, 1)
+        assert fmt.format(rec) == "hello"          # byte-identical solo
+        set_rank_context(1, 2)
+        assert fmt.format(rec) == "[r1/2] hello"   # attributable in gangs
+        set_rank_context(0, 2)
+        assert fmt.format(rec) == "[r0/2] hello"
+    finally:
+        set_rank_context(0, 1)
+
+
+# ------------------------------------------- metrics_report rank satellites
+
+def _rank_record(step, rank=None, tps=100.0):
+    rec = {"step": step, "ts": float(step), "loss": 2.0, "step_time": 0.1,
+           "tokens_per_sec": tps, "mfu": None}
+    if rank is not None:
+        rec.update(rank=rank, world=2, schema_version=2)
+    return rec
+
+
+def test_metrics_report_directory_merges_rank_files(tmp_path, capsys):
+    import tools.metrics_report as mr
+
+    for rank in (0, 1):
+        with open(tmp_path / f"metrics.rank{rank}.jsonl", "w") as f:
+            for step in (1, 2):
+                f.write(json.dumps(_rank_record(
+                    step, rank, tps=100.0 * (rank + 1))) + "\n")
+    assert mr.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "metrics.rank0.jsonl" in out and "metrics.rank1.jsonl" in out
+    assert "merged" in out and "offline merge" in out
+
+    # rank 0's merged gang stream, when present, IS the merged view
+    with open(tmp_path / "metrics.gang.jsonl", "w") as f:
+        for step in (1, 2):
+            rec = dict(_rank_record(step, 0, tps=100.0), scope="gang",
+                       world=2, ranks_reported=2)
+            f.write(json.dumps(rec) + "\n")
+    summary_path = str(tmp_path / "s.json")
+    assert mr.main([str(tmp_path), "--json", summary_path]) == 0
+    out = capsys.readouterr().out
+    assert "metrics.gang.jsonl" in out
+    summary = json.loads(open(summary_path).read())
+    assert summary["records"] == 2
+    assert set(summary["per_rank"]) == {"metrics.rank0.jsonl",
+                                        "metrics.rank1.jsonl"}
+
+    # a directory holding ONLY the merged gang stream (rank 0's copied
+    # evidence) is a valid run, not a refusal
+    gang_only = tmp_path / "gang_only"
+    gang_only.mkdir()
+    (gang_only / "metrics.gang.jsonl").write_text(
+        (tmp_path / "metrics.gang.jsonl").read_text())
+    assert mr.main([str(gang_only)]) == 0
+    capsys.readouterr()
+
+
+def test_metrics_report_refuses_schema_version_mix(tmp_path, capsys):
+    import tools.metrics_report as mr
+
+    with open(tmp_path / "metrics.rank0.jsonl", "w") as f:
+        f.write(json.dumps(_rank_record(1, rank=0)) + "\n")
+    with open(tmp_path / "metrics.rank1.jsonl", "w") as f:
+        f.write(json.dumps(_rank_record(1)) + "\n")   # version-1 record
+    assert mr.main([str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert "schema-version mismatch" in err
+
+    # a single file interleaving versions is refused too
+    mixed = tmp_path / "mixed.jsonl"
+    with open(mixed, "w") as f:
+        f.write(json.dumps(_rank_record(1, rank=0)) + "\n")
+        f.write(json.dumps(_rank_record(2)) + "\n")
+    assert mr.main([str(mixed)]) == 2
+    assert "mixes schema versions" in capsys.readouterr().err
+
+
+# ----------------------------------------------------- postmortem satellite
+
+def _write_flight(tmp_path, rank, events, reason, world=2):
+    d = tmp_path / f"rank{rank}"
+    d.mkdir(exist_ok=True)
+    with open(d / f"flight_rank{rank}.json", "w") as f:
+        json.dump({"rank": rank, "world": world, "reason": reason,
+                   "dumped_at": 100.0 + rank,
+                   "recorded_total": len(events), "capacity": 512,
+                   "events": events}, f)
+
+
+def test_postmortem_census_names_first_diverging_rank(tmp_path, capsys):
+    import tools.postmortem as pm
+
+    _write_flight(tmp_path, 0, [
+        {"t": 1.0, "kind": "span", "name": "train_step"},
+        {"t": 9.0, "kind": "coord_timeout", "name": "loop_flags#2",
+         "missing": [1], "arrived": [0]},
+    ], "crash:CoordinationTimeout")
+    _write_flight(tmp_path, 1, [
+        {"t": 1.0, "kind": "span", "name": "train_step"},
+    ], "crash:InjectedFault")
+    assert pm.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "first-diverging rank: 1" in out
+    assert "coordination-timeout census" in out
+    assert "crash:InjectedFault" in out            # per-rank last words
+
+
+def test_postmortem_last_event_heuristic_and_json(tmp_path, capsys):
+    import tools.postmortem as pm
+
+    # no census recorded: the rank whose stream stops first diverged
+    _write_flight(tmp_path, 0, [
+        {"t": 1.0, "kind": "span", "name": "train_step"},
+        {"t": 8.0, "kind": "span", "name": "train_step"},
+    ], "crash:RuntimeError")
+    _write_flight(tmp_path, 1, [
+        {"t": 1.0, "kind": "span", "name": "train_step"},
+        {"t": 2.5, "kind": "span", "name": "data_fetch"},
+    ], "crash:OSError")
+    report_path = str(tmp_path / "report.json")
+    assert pm.main([str(tmp_path), "--json", report_path]) == 0
+    rep = json.loads(open(report_path).read())
+    assert rep["first_diverging_rank"] == 1
+    assert rep["diverging_evidence"] == "earliest last-recorded event"
+    assert rep["ranks"] == [0, 1] and rep["world"] == 2
+    # merged timeline is time-sorted and rank-tagged
+    ts = [e["t"] for e in rep["timeline_tail"]]
+    assert ts == sorted(ts)
+    assert {e["rank"] for e in rep["timeline_tail"]} == {0, 1}
+    # no dumps anywhere → usage error, not a silent empty report
+    assert pm.main([str(tmp_path / "nowhere")]) == 2

@@ -233,6 +233,46 @@ def test_distributed_majority_deterministic_tie_break():
         assert f1.result(timeout=10) == "a"
 
 
+def test_collective_wait_metrics_and_skew_on_fake_kv():
+    """Every agreement records its wait into ``barrier_wait_ms`` and
+    feeds the per-rank arrival census to the installed hook — the rolling
+    skew estimate names the straggler while the gang is still healthy
+    (docs/observability.md "Multi-host")."""
+    from fleetx_tpu.observability import gang as obs_gang
+    from fleetx_tpu.observability.metrics import DerivedMetrics, get_registry
+
+    kv = _FakeKV()
+    r0, r1 = _pair(kv)
+    derived = DerivedMetrics(ewma_alpha=1.0)
+    censuses = []
+
+    def hook(arrivals):
+        censuses.append(arrivals)
+        derived.update_arrivals(arrivals)
+
+    prev = obs_gang.set_arrival_hook(hook)
+    reg = get_registry()
+    base_count = reg.histogram("barrier_wait_ms").summary().get("count", 0)
+    try:
+        with ThreadPoolExecutor(2) as pool:
+            f1 = pool.submit(r1.all_gather, "skew_probe", 1)
+            time.sleep(0.25)  # rank 0 is the straggler this round
+            f0 = pool.submit(r0.all_gather, "skew_probe", 0)
+            assert f0.result(timeout=10) == {0: 0, 1: 1}  # values unwrapped
+            assert f1.result(timeout=10) == {0: 0, 1: 1}
+    finally:
+        obs_gang.set_arrival_hook(prev)
+    # both coordinator objects live in this process: two hook calls with
+    # the identical census
+    assert len(censuses) == 2
+    assert censuses[0][0] - censuses[0][1] > 0.15  # rank 0 published later
+    assert derived.slowest_rank() == 0
+    assert derived.rank_skew()[0] > 0.05
+    assert reg.histogram("barrier_wait_ms").summary()["count"] >= \
+        base_count + 2
+    assert reg.gauge("coord_last_rank").value == 0  # last arriver named
+
+
 def test_distributed_gather_garbage_collects_old_generations():
     kv = _FakeKV()
     r0, r1 = _pair(kv)
@@ -648,6 +688,37 @@ def test_supervisor_restarts_crash_then_succeeds(tmp_path):
     assert "restart 1/2" in err
 
 
+def test_supervisor_passes_per_rank_per_generation_flight_dir(tmp_path):
+    """Every gang member gets its own FLEETX_FLIGHT_DIR under
+    ``--flight-dir``, and a restarted generation gets a FRESH one — the
+    dump that explains restart N must survive restart N+1."""
+    base = tmp_path / "fl"
+    envlog = str(tmp_path / "envs")
+    marker = str(tmp_path / "crashed_once")
+    script = ("import os, sys\n"
+              "rank = os.environ.get('FLEETX_PROCESS_ID', '0')\n"
+              "with open(sys.argv[2] + rank, 'a') as f:\n"
+              "    f.write(os.environ.get('FLEETX_FLIGHT_DIR', '') + '\\n')\n"
+              "m = sys.argv[1]\n"
+              "if os.path.exists(m):\n"
+              "    sys.exit(0)\n"
+              "open(m, 'w').write('x')\n"
+              "sys.exit(1)\n")
+    rc, _, err = _supervise(
+        ["--num-procs", "2", "--max-restart", "2", "--backoff", "0",
+         "--grace", "5", "--flight-dir", str(base)],
+        [sys.executable, "-c", script, marker, envlog])
+    assert rc == 0, err[-1500:]
+    # rank 0 crashed generation 0, both ranks relaunched as generation 1
+    gens0 = open(envlog + "0").read().splitlines()
+    assert gens0[0] == str(base / "gen0" / "rank0")
+    assert gens0[-1] == str(base / "gen1" / "rank0")
+    # rank 1's generation-0 line can be raced away by the gang kill; the
+    # relaunched generation's per-rank path is the property under test
+    gens1 = open(envlog + "1").read().splitlines()
+    assert gens1[-1] == str(base / "gen1" / "rank1")
+
+
 def test_supervisor_give_up_maps_signal_exit_code():
     """The give-up path must report a signal-killed member as 128+N like
     the forwarded-signal path does — ``sys.exit(-9)`` truncates to 247,
@@ -767,6 +838,9 @@ def test_supervisor_post_signal_survivor_of_sigkill_not_masked():
         def kill_all(self, grace):
             pass
 
+        def collect_flights(self):
+            return []
+
         def returncodes(self):
             return [0, None]  # sibling clean; member survived SIGKILL
 
@@ -823,6 +897,10 @@ def _worker_cmd(out_dir, status_tpl, steps, seed, **kw):
         cmd += ["--sdc-every", str(kw["sdc_every"])]
     if kw.get("sdc_action"):
         cmd += ["--sdc-action", kw["sdc_action"]]
+    if kw.get("obs"):
+        cmd += ["--obs"]
+    if kw.get("coord_timeout"):
+        cmd += ["--coord-timeout", str(kw["coord_timeout"])]
     return cmd
 
 
@@ -1027,3 +1105,106 @@ def test_gang_divergent_checkpoint_views_follow_rank0_or_fail(tmp_path):
     sts = _statuses(status)
     assert sts[1]["exit"] == "error", sts[1]
     assert "divergent checkpoint views" in sts[1]["error"], sts[1]
+
+
+@needs_gang
+def test_gang_metric_aggregation_merges_ranks(tmp_path):
+    """The aggregation acceptance drill (docs/observability.md
+    "Multi-host"): with ``Observability.gang`` on, every rank writes its
+    own ``metrics.rank<i>.jsonl`` (rank/world/schema_version stamped) and
+    rank 0's ``metrics.gang.jsonl`` carries gang-merged records — summed
+    counters, step-time min/median/max with rank attribution, slowest-rank
+    throughput — piggybacked on the loop-control vote (no new
+    rendezvous)."""
+    out = tmp_path / "ckpt"
+    status = tmp_path / "status_{rank}.json"
+    rc, _, err = _supervise(
+        ["--num-procs", "2", "--max-restart", "0", "--preemption-code",
+         "75", "--flight-dir", str(tmp_path / "flight")],
+        _worker_cmd(out, status, 4, 33, obs=True), timeout_s=240)
+    assert rc == 0, err[-3000:]
+    sts = _statuses(status)
+    for rank, st in sts.items():
+        assert st["exit"] == "completed", st
+        assert st["barrier_waits"] > 0, st  # collective-wait instrumented
+        assert st["coord_agreements"] > 0, st
+        per_rank = (out / f"rank_{rank}" / "telemetry"
+                    / f"metrics.rank{rank}.jsonl")
+        assert per_rank.exists(), st
+        records = [json.loads(l) for l in open(per_rank)]
+        assert len(records) == 4
+        for rec in records:
+            assert rec["rank"] == rank and rec["world"] == 2, rec
+            assert rec["schema_version"] == 2, rec
+    # only rank 0 merges; the gang stream lives in ITS telemetry dir
+    gang_file = out / "rank_0" / "telemetry" / "metrics.gang.jsonl"
+    assert gang_file.exists()
+    assert not (out / "rank_1" / "telemetry"
+                / "metrics.gang.jsonl").exists()
+    merged = [json.loads(l) for l in open(gang_file)]
+    assert len(merged) == 4  # every window merged, incl. the exit vote's
+    for rec in merged:
+        assert rec["scope"] == "gang" and rec["world"] == 2, rec
+        assert rec["ranks_reported"] == 2, rec
+        assert rec["step_time_max_rank"] in (0, 1), rec
+        assert rec["step_time_min"] <= rec["step_time_median"] \
+            <= rec["step_time_max"], rec
+        assert rec["step_time"] == rec["step_time_max"], rec
+        assert rec["tokens_per_sec"] > 0, rec
+        # healthy drill: summed resilience counters are present and zero
+        assert rec["rollbacks_total"] == 0 and rec["preemption_exits"] == 0
+    assert [r["step"] for r in merged] == [1, 2, 3, 4]
+
+    # the per-rank files summarize + merge offline through the satellite
+    import tools.metrics_report as mr
+    glob_spec = str(out / "rank_*" / "telemetry" / "metrics.rank*.jsonl")
+    assert mr.main([glob_spec]) == 0
+    # a clean completion triggers no flight dumps
+    assert not list((tmp_path / "flight").rglob("flight_rank*.json"))
+
+
+@needs_gang
+def test_gang_crash_leaves_flight_dumps_postmortem_names_rank(tmp_path):
+    """The crash acceptance drill: rank 1 dies hard mid-run (injected
+    data-path raise). Rank 0's next loop-control vote expires with a
+    straggler census, BOTH ranks' flight rings are dumped under the
+    supervisor's per-generation FLEETX_FLIGHT_DIR, and
+    ``tools/postmortem.py`` merges them into one timeline naming rank 1
+    as first-diverging."""
+    out = tmp_path / "ckpt"
+    status = tmp_path / "status_{rank}.json"
+    flight_dir = tmp_path / "flight"
+    rc, _, err = _supervise(
+        ["--num-procs", "2", "--max-restart", "0", "--preemption-code",
+         "75", "--flight-dir", str(flight_dir)],
+        _worker_cmd(out, status, 6, 13, obs=True, coord_timeout=10,
+                    faults="data_raise_at=2,only_rank=1"),
+        timeout_s=240)
+    assert rc == 4, err[-3000:]  # both ranks crashed, supervisor reports it
+    sts = _statuses(status)
+    assert sts[1]["exit"] == "error" and "InjectedFault" in sts[1]["error"]
+    assert sts[0]["exit"] == "error", sts[0]
+    assert "CoordinationTimeout" in sts[0]["error"], sts[0]
+
+    r0_dump = flight_dir / "gen0" / "rank0" / "flight_rank0.json"
+    r1_dump = flight_dir / "gen0" / "rank1" / "flight_rank1.json"
+    assert r0_dump.exists(), err[-3000:]
+    assert r1_dump.exists(), err[-3000:]
+    assert "flight-recorder dumps" in err  # supervisor collected them
+    assert "postmortem.py" in err
+
+    dump0 = json.loads(r0_dump.read_text())
+    assert dump0["reason"].startswith("crash:CoordinationTimeout")
+    assert any(e["kind"] == "coord_timeout" and e["missing"] == [1]
+               for e in dump0["events"]), dump0["events"][-5:]
+    dump1 = json.loads(r1_dump.read_text())
+    assert dump1["reason"].startswith("crash:InjectedFault")
+
+    import tools.postmortem as pm
+    dumps, errors = pm.load_dumps(
+        pm.find_flight_files([str(flight_dir)]))
+    assert errors == [] and sorted(dumps) == [0, 1]
+    rep = pm.report(dumps, tail=20)
+    assert rep["first_diverging_rank"] == 1, rep
+    assert rep["diverging_evidence"] == "coordination-timeout census"
+    assert pm.main([str(flight_dir / "gen0")]) == 0

@@ -3,6 +3,8 @@
 Usage::
 
     python tools/metrics_report.py output/telemetry/metrics.jsonl
+    python tools/metrics_report.py output/telemetry/           # per-rank dir
+    python tools/metrics_report.py 'out/telemetry/metrics.rank*.jsonl'
     python tools/metrics_report.py run.jsonl --json summary.json
     python tools/metrics_report.py run.jsonl --compare BENCH_SELF.json:gpt
 
@@ -12,6 +14,14 @@ non-zero, so this tool gates bench runs — a pipeline that silently logged
 NaN losses or dropped its MFU field fails loudly here, not three rounds
 later in a BENCHMARKS.md table.
 
+Multi-host runs (``Observability.gang``, docs/observability.md
+"Multi-host") write per-rank files: pass the telemetry DIRECTORY or a
+glob and the report shows a per-rank view next to the merged gang view
+(rank 0's ``metrics.gang.jsonl`` when present, else an offline merge via
+``observability/gang.py``). Files whose records carry different schema
+versions are REFUSED — silently mixing a pre-gang run's records with
+per-rank records would produce a summary describing neither run.
+
 ``--json`` writes the summary as machine-readable JSON in the same spirit
 as the ``BENCH_*.json`` result entries (tokens/s value + step time + MFU),
 and ``--compare FILE:KEY`` diffs the run's throughput against a committed
@@ -19,13 +29,16 @@ and ``--compare FILE:KEY`` diffs the run's throughput against a committed
 """
 
 import argparse
+import glob as glob_mod
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from fleetx_tpu.observability.schema import validate_jsonl  # noqa: E402
+from fleetx_tpu.observability.gang import merge_rank_records  # noqa: E402
+from fleetx_tpu.observability.schema import (  # noqa: E402
+    record_schema_version, validate_jsonl)
 
 
 def _stats(values):
@@ -109,36 +122,133 @@ def compare(summary: dict, spec: str) -> int:
     return 0
 
 
+def resolve_inputs(spec: str) -> tuple[list[str], str | None]:
+    """``spec`` (file | directory | glob) → (rank/run files, gang file).
+
+    A directory prefers the per-rank layout (``metrics.rank*.jsonl``) and
+    the rank-0 merged stream (``metrics.gang.jsonl``); a single-file run
+    falls back to the classic ``metrics.jsonl``.
+    """
+    if os.path.isdir(spec):
+        ranks = sorted(glob_mod.glob(os.path.join(spec,
+                                                  "metrics.rank*.jsonl")))
+        gang = os.path.join(spec, "metrics.gang.jsonl")
+        gang = gang if os.path.exists(gang) else None
+        if ranks:
+            return ranks, gang
+        single = os.path.join(spec, "metrics.jsonl")
+        if os.path.exists(single):
+            return [single], gang
+        # only the merged gang stream present (rank 0's copied evidence):
+        # summarize it as the run, don't refuse a perfectly valid input
+        return ([gang] if gang else []), None
+    if os.path.exists(spec):
+        return [spec], None
+    hits = sorted(glob_mod.glob(spec))
+    matches = [p for p in hits if not p.endswith("metrics.gang.jsonl")]
+    gang = next((p for p in hits if p.endswith("metrics.gang.jsonl")),
+                None)
+    if not matches and gang:
+        return [gang], None
+    return matches, gang
+
+
+def _load_validated(path: str) -> tuple[list[dict] | None, int]:
+    """Validate + parse one JSONL file; (records, rc) with rc != 0 on any
+    schema violation or an empty file (the bench-gate contract)."""
+    count, errors = validate_jsonl(path)
+    if errors:
+        print(f"error: {path} failed schema validation "
+              f"({len(errors)} problem(s) in {count} record(s)):",
+              file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return None, 1
+    if not count:
+        print(f"error: {path} contains no records", file=sys.stderr)
+        return None, 1
+    with open(path) as f:
+        records = [json.loads(l) for l in f if l.strip()]
+    records.sort(key=lambda r: r["step"])
+    return records, 0
+
+
+def _check_schema_versions(by_file: dict) -> int | None:
+    """One schema version across every input, or None (the refusal).
+
+    Mixing a pre-gang run's version-1 records with per-rank version-2
+    files would silently produce a summary describing neither run — the
+    classic stale-telemetry-dir failure — so a mismatch is an error, not
+    a warning.
+    """
+    versions = {}
+    for path, records in by_file.items():
+        file_versions = {record_schema_version(r) for r in records}
+        if len(file_versions) > 1:
+            print(f"error: {path} mixes schema versions "
+                  f"{sorted(file_versions)} — refusing to summarize a "
+                  f"file that interleaves different runs", file=sys.stderr)
+            return None
+        versions[path] = file_versions.pop()
+    if len(set(versions.values())) > 1:
+        print("error: schema-version mismatch across inputs — refusing to "
+              "mix runs:", file=sys.stderr)
+        for path, v in sorted(versions.items()):
+            print(f"  v{v}: {path}", file=sys.stderr)
+        return None
+    return next(iter(versions.values()))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="validate + summarize a telemetry metrics.jsonl")
-    ap.add_argument("jsonl", help="path to metrics.jsonl")
+        description="validate + summarize telemetry metrics JSONL "
+                    "(file, per-rank directory, or glob)")
+    ap.add_argument("jsonl", help="metrics.jsonl path, telemetry "
+                                  "directory, or glob of rank files")
     ap.add_argument("--json", metavar="OUT",
                     help="also write the summary as JSON (- for stdout)")
     ap.add_argument("--compare", metavar="FILE:KEY",
                     help="diff tokens/s against a BENCH_*.json result entry")
     args = ap.parse_args(argv)
 
-    if not os.path.exists(args.jsonl):
-        print(f"error: {args.jsonl} not found", file=sys.stderr)
-        return 2
-    count, errors = validate_jsonl(args.jsonl)
-    if errors:
-        print(f"error: {args.jsonl} failed schema validation "
-              f"({len(errors)} problem(s) in {count} record(s)):",
+    files, gang_file = resolve_inputs(args.jsonl)
+    if not files:
+        print(f"error: {args.jsonl} matched no metrics JSONL",
               file=sys.stderr)
-        for e in errors:
-            print(f"  {e}", file=sys.stderr)
-        return 1
-    if not count:
-        print(f"error: {args.jsonl} contains no records", file=sys.stderr)
-        return 1
+        return 2
 
-    with open(args.jsonl) as f:
-        records = [json.loads(l) for l in f if l.strip()]
-    records.sort(key=lambda r: r["step"])
-    summary = summarize(records)
-    print_table(summary)
+    by_file: dict = {}
+    for path in files + ([gang_file] if gang_file else []):
+        records, rc = _load_validated(path)
+        if rc:
+            return rc
+        by_file[path] = records
+    if _check_schema_versions(by_file) is None:
+        return 2
+
+    if len(files) == 1 and not gang_file:
+        summary = summarize(by_file[files[0]])
+        print_table(summary)
+    else:
+        # per-rank views first, merged gang view last (the headline)
+        per_rank = {}
+        for path in files:
+            name = os.path.basename(path)
+            per_rank[name] = summarize(by_file[path])
+            print(f"== {name}")
+            print_table(per_rank[name])
+            print()
+        if gang_file:
+            merged_records = by_file[gang_file]
+            merged_label = os.path.basename(gang_file)
+        else:
+            merged_records = merge_rank_records(
+                {path: by_file[path] for path in files})
+            merged_label = f"offline merge of {len(files)} rank files"
+        summary = summarize(merged_records)
+        summary["per_rank"] = per_rank
+        print(f"== merged ({merged_label})")
+        print_table(summary)
 
     if args.json:
         payload = json.dumps(summary, indent=1)

@@ -40,6 +40,7 @@ Usage (what ``projects/*.sh`` invoke)::
 from __future__ import annotations
 
 import argparse
+import glob
 import os
 import signal
 import socket
@@ -67,15 +68,26 @@ def _free_port() -> int:
 class Gang:
     """One generation of N child processes forming a JAX gang."""
 
-    def __init__(self, cmd: list, num_procs: int):
+    def __init__(self, cmd: list, num_procs: int,
+                 flight_base: str | None = None):
         self.cmd = list(cmd)
         self.num_procs = int(num_procs)
+        self.flight_base = flight_base
+        self.generation = -1  # bumped to 0 by the first launch
         self.procs: list = []
 
     def launch(self) -> None:
         """Start all members; multi-process gangs get a fresh coordinator
         address per generation (the previous service's port may linger in
-        TIME_WAIT after a gang kill)."""
+        TIME_WAIT after a gang kill).
+
+        Every member also gets a per-rank, per-generation
+        ``FLEETX_FLIGHT_DIR`` so a restarted gang's crash flight dumps
+        (docs/observability.md "Multi-host") never overwrite the previous
+        generation's evidence — the dump that explains restart N is
+        useless if restart N+1 clobbers it.
+        """
+        self.generation += 1
         env = dict(os.environ)
         if self.num_procs > 1:
             env["FLEETX_COORDINATOR"] = f"127.0.0.1:{_free_port()}"
@@ -85,10 +97,25 @@ class Gang:
             child_env = dict(env)
             if self.num_procs > 1:
                 child_env["FLEETX_PROCESS_ID"] = str(rank)
+            if self.flight_base:
+                child_env["FLEETX_FLIGHT_DIR"] = os.path.join(
+                    self.flight_base, f"gen{self.generation}",
+                    f"rank{rank}")
             # own process group/session: signals forwarded with killpg
             # reach the trainer AND anything it spawned (data workers)
             self.procs.append(subprocess.Popen(self.cmd, env=child_env,
                                                start_new_session=True))
+
+    def collect_flights(self) -> list:
+        """The current generation's flight dumps (survivors' evidence,
+        gathered after a gang kill so the operator — and the restart's
+        logs — know where the post-mortem material landed)."""
+        if not self.flight_base or self.generation < 0:
+            return []
+        pattern = os.path.join(self.flight_base,
+                               f"gen{self.generation}", "*",
+                               "flight_rank*.json")
+        return sorted(glob.glob(pattern))
 
     def poll(self) -> dict:
         """rank → returncode for members that have exited."""
@@ -186,6 +213,12 @@ def main(argv=None) -> int:
                              "host, naming it")
     parser.add_argument("--preflight-timeout", type=float, default=120.0,
                         help="seconds each preflight self-test may take")
+    parser.add_argument("--flight-dir", default=None,
+                        help="base directory for crash flight-recorder "
+                             "dumps; each member gets a per-rank, "
+                             "per-generation FLEETX_FLIGHT_DIR under it "
+                             "(default: $FLEETX_FLIGHT_DIR or "
+                             "./flight_recorder)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the training command")
     args = parser.parse_args(argv)
@@ -207,7 +240,10 @@ def main(argv=None) -> int:
         print(f"[supervise] preflight passed on all {args.num_procs} "
               f"members", file=sys.stderr)
 
-    gang = Gang(cmd, args.num_procs)
+    flight_base = (args.flight_dir
+                   or os.environ.get("FLEETX_FLIGHT_DIR")
+                   or "./flight_recorder")
+    gang = Gang(cmd, args.num_procs, flight_base=flight_base)
     forwarded = {"sig": None}
 
     def _forward(signum, frame):
@@ -238,6 +274,22 @@ def _shell_code(rc: int) -> int:
     """Map a Popen returncode to a shell exit status (128+N for signals)
     — ``sys.exit(-9)`` would otherwise truncate to 247, not 137."""
     return 128 - rc if rc < 0 else rc
+
+
+def _report_flights(gang: Gang) -> None:
+    """Name the generation's flight dumps after an abnormal stop — the
+    survivors' evidence a gang kill would otherwise bury under the next
+    generation's logs."""
+    flights = gang.collect_flights()
+    if not flights:
+        return
+    print(f"[supervise] flight-recorder dumps (generation "
+          f"{gang.generation}):", file=sys.stderr)
+    for path in flights:
+        print(f"[supervise]   {path}", file=sys.stderr)
+    print(f"[supervise] merge the timeline with: python tools/postmortem.py "
+          f"{os.path.join(gang.flight_base or '', f'gen{gang.generation}')}",
+          file=sys.stderr)
 
 
 def _run(gang: Gang, args, clean_codes: set, forwarded: dict) -> int:
@@ -293,6 +345,7 @@ def _run(gang: Gang, args, clean_codes: set, forwarded: dict) -> int:
                         print("[supervise] gang member still running after "
                               "SIGKILL — reporting failure", file=sys.stderr)
                         rc = -signal.SIGKILL
+                    _report_flights(gang)
                 else:
                     rc = bad[0] if bad else 0
                 return _shell_code(rc)
@@ -315,6 +368,10 @@ def _run(gang: Gang, args, clean_codes: set, forwarded: dict) -> int:
         # a JAX gang cannot shrink around a lost member: tear the whole
         # generation down before the restart brings N fresh processes up
         gang.kill_all(args.grace)
+        # collect the survivors' flight dumps NOW, while the generation's
+        # identity is known — the restart reuses the base dir with a new
+        # generation suffix, so nothing is overwritten either way
+        _report_flights(gang)
     print(f"[supervise] giving up after {args.max_restart} restarts",
           file=sys.stderr)
     return _shell_code(rc)
