@@ -341,6 +341,41 @@ def _bench_impl() -> dict:
         result["fit_error"] = fit_error
     if remat_save_dtype:
         result["remat_save_dtype"] = remat_save_dtype
+
+    # HBM attribution (docs/performance.md): measured peak vs auto_layout's
+    # prediction for this exact config; "unavailable" is the explicit
+    # marker for backends without memory_stats (axon tunnel, cpu) so an
+    # unknown peak never reads as a measured zero. Own try — the PR-3
+    # phase-isolation stance: an attribution failure must never discard
+    # the measured throughput above.
+    try:
+        hbm = (engine.mem.snapshot() if engine.mem is not None
+               else {"available": False})
+        result["hbm_stats"] = "ok" if hbm.get("available") else "unavailable"
+        result["hbm_peak_bytes"] = hbm.get("peak_bytes")
+        result["hbm_model_error"] = hbm.get("model_error")
+    except Exception as e:
+        result["hbm_stats"] = f"error: {type(e).__name__}: {e}"[:120]
+
+    # trace decomposition (docs/performance.md): when the watcher armed a
+    # profiler capture, score it so the committed artifact carries the
+    # MFU-gap report next to the tokens/s it explains. Same isolation.
+    if trace_dir:
+        try:
+            from fleetx_tpu.observability import perf as perf_mod
+            from fleetx_tpu.utils.hardware import (gpt_flops_per_token,
+                                                   roofline)
+
+            flops = gpt_flops_per_token(layers, HIDDEN, seq,
+                                        num_params=n_params) * bsz * seq
+            rep = perf_mod.analyze(
+                trace_dir, flops_per_step=flops,
+                roofline=roofline(getattr(dev, "device_kind", "")))
+            result["decomposition"] = perf_mod.summary(rep)
+        except Exception as e:
+            result["decomposition_error"] = \
+                f"{type(e).__name__}: {e}"[:200]
+
     from fleetx_tpu.utils.hardware import gpt_flops_per_token, peak_flops
 
     peak = peak_flops(dev)

@@ -37,7 +37,7 @@ from flax.core import meta
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fleetx_tpu.core import checkpoint as ckpt_lib
-from fleetx_tpu.observability import Observability, flight
+from fleetx_tpu.observability import MemoryMonitor, Observability, flight
 from fleetx_tpu.observability.trace import ProfilerWindow
 from fleetx_tpu.parallel.mesh import build_mesh
 from fleetx_tpu.parallel.sharding import (make_axis_rules, zero_grad_specs,
@@ -268,6 +268,13 @@ class EagerEngine(BasicEngine):
         self.obs = Observability(self.cfg.get("Observability"),
                                  default_output_dir=self.output_dir)
         self._engine_kind = type(self).__name__
+        # performance introspection (docs/performance.md): every closed
+        # profiler window is decomposed into the MFU-gap report and landed
+        # in the perf stream + flight ring automatically
+        self.profiler.on_stop = self._on_profiler_stop
+        self.mem = None  # HBM monitor — built in prepare (mesh known)
+        self._perf_flops_per_step = None
+        self._perf_report = None
 
         self.optimizer = optimizer
         self.lr_schedule = lr_schedule
@@ -375,6 +382,15 @@ class EagerEngine(BasicEngine):
                 # every coordination agreement's arrival census feeds the
                 # rolling per-rank skew estimate from here on
                 self.obs.install_arrival_hook()
+        if self.obs.enabled and self.mem is None:
+            # HBM attribution (docs/performance.md): sample memory_stats
+            # at phase boundaries and score the measured peak against the
+            # auto_layout prediction for THIS config (hbm_model_error) —
+            # closing the loop on the model that plans offload/stages
+            self.mem = MemoryMonitor(
+                registry=self.obs.registry,
+                predicted_bytes=self._predicted_hbm_bytes())
+            self.mem.sample("post_compile")
         if self.ckpt_dir:
             self.load(self.ckpt_dir)
         return self.state
@@ -795,6 +811,17 @@ class EagerEngine(BasicEngine):
         # consumed_samples counts GLOBAL samples (the sampler's unit): the
         # per-host leading dim times the number of hosts
         global_batch = _leading_dim(first) * jax.process_count()
+        # model FLOPs per optimizer step for the trace decomposition's
+        # roofline: PER-HOST (leading dim, not global_batch) because the
+        # profiler trace only carries this host's devices and mfu_gap
+        # divides by that count. None for non-LM modules — the report
+        # then ranks raw category costs without an ideal-time floor.
+        fpt = (self.module.flops_per_token()
+               if hasattr(self.module, "flops_per_token") else None)
+        tps = getattr(self.module, "tokens_per_sample", None)
+        self._perf_flops_per_step = (
+            float(fpt) * int(tps) * _leading_dim(first)
+            if fpt and tps else None)
         start_step = int(jax.device_get(self.state.step))
         # sample position at fit entry: rollback rewinds relative to this
         # when the loader has no consumed_samples sampler
@@ -1361,6 +1388,67 @@ class EagerEngine(BasicEngine):
             return losses
 
     # ------------------------------------------------------------ telemetry
+    def _predicted_hbm_bytes(self):
+        """``auto_layout``'s per-device HBM prediction for this config, or
+        None for modules its first-order GPT-family model cannot describe
+        (the monitor then reports measured peaks without a model error)."""
+        if not self.cfg.get("Model") or \
+                not hasattr(self.module, "flops_per_token"):
+            return None
+        try:
+            from fleetx_tpu.parallel.auto_layout import (
+                advice_inputs, predicted_step_bytes)
+
+            data_world = max(int(self.mesh.shape["data"])
+                             * int(self.mesh.shape["fsdp"]), 1)
+            mdl, mb, gran = advice_inputs(self.cfg, data_world=data_world)
+            return predicted_step_bytes(
+                mdl, dict(self.cfg.get("Distributed") or {}), mb, gran)
+        except Exception as e:  # noqa: BLE001 — advisory, never fatal
+            logger.warning("hbm prediction unavailable: %s: %s",
+                           type(e).__name__, e)
+            return None
+
+    def _on_profiler_stop(self, trace_dir: str) -> None:
+        """Decompose the just-closed profiler window (docs/performance.md).
+
+        Installed as ``ProfilerWindow.on_stop``: parses the Chrome trace
+        the window dumped, scores it against the calibrated roofline and
+        lands the report in the perf stream (``perf.jsonl``), the gauge
+        surface and the flight ring — so every profiled fit window yields
+        the BENCHMARKS.md-style decomposition mechanically. Best-effort:
+        a parse failure logs and training continues.
+        """
+        obs = self.obs
+        if not obs.perf_enabled:
+            return
+        try:
+            from fleetx_tpu.observability import perf
+            from fleetx_tpu.utils.hardware import roofline
+
+            rl = roofline(getattr(jax.devices()[0], "device_kind", ""))
+            axis_sizes = {str(a): int(s)
+                          for a, s in dict(self.mesh.shape).items()
+                          if int(s) > 1}
+            report = perf.analyze(
+                trace_dir, flops_per_step=self._perf_flops_per_step,
+                roofline=rl, axis_sizes=axis_sizes or None,
+                top_k=obs.perf_top_k)
+            if self.mem is not None:
+                self.mem.sample("profile_stop")
+                report["hbm"] = self.mem.snapshot()
+            self._perf_report = report
+            obs.emit_perf(report)
+            gap = report.get("mfu_gap") or {}
+            top = ", ".join(
+                f"{c['name']} {c['ms_per_step']:.1f}ms"
+                for c in (gap.get("contributors") or [])[:3])
+            logger.info("trace decomposition: step %.1f ms, mfu %s — top "
+                        "gap: %s", report["step_ms"], gap.get("mfu"), top)
+        except Exception as e:  # noqa: BLE001 — telemetry never kills a run
+            logger.warning("trace decomposition failed for %s: %s: %s",
+                           trace_dir, type(e).__name__, e)
+
     def _emit_train_record(self, log_dict: dict, metrics: dict) -> None:
         """One machine-readable record per logging window → the sinks.
 
@@ -1392,6 +1480,11 @@ class EagerEngine(BasicEngine):
             "engine": self._engine_kind,
         }
         record.update(derived)
+        if self.mem is not None:
+            # steady-state HBM sample once per window: peak/live gauges +
+            # the model error riding every record (docs/performance.md)
+            self.mem.sample("steady_state")
+            record.update(self.mem.record_keys())
         if "grad_norm" in metrics:
             record["grad_norm"] = float(metrics["grad_norm"])
         if "loss_scale" in metrics:
@@ -1425,6 +1518,8 @@ class EagerEngine(BasicEngine):
                     self._eval_step(self.state, self.shard_batch(batch)))
                 total += float(metrics["loss"])
                 count += 1
+        if self.mem is not None:
+            self.mem.sample("eval")
         if count:
             self.module.validation_step_end({
                 "global_step": global_step, "batch": count,
@@ -1483,6 +1578,10 @@ class EagerEngine(BasicEngine):
                       "epoch": getattr(self, "_epoch", self._start_epoch),
                       "seed": self.seed},
                 async_save=self.async_save)
+        if self.mem is not None:
+            # checkpoint saves materialize host copies / extra buffers —
+            # a phase boundary worth its own HBM sample
+            self.mem.sample("checkpoint_save")
         if self.keep_last:
             # retention GC considers only COMPLETED step dirs and never
             # prunes the newest one, so an in-flight async save (meta not

@@ -54,6 +54,13 @@ STEP_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "barrier_wait_ms_mean": (_NUM, False),
     "barrier_wait_ms_max": (_NUM, False),
     "barrier_wait_ms_max_rank": ((int,), False),
+    # HBM attribution (docs/performance.md): measured peak next to the
+    # auto_layout prediction's relative error; ``hbm_stats`` is the
+    # explicit availability marker — backends without ``memory_stats()``
+    # say "unavailable" instead of faking a zero peak
+    "hbm_stats": ((str,), False),
+    "hbm_peak_bytes": (_NULLABLE_NUM, False),
+    "hbm_model_error": (_NULLABLE_NUM, False),
 }
 
 

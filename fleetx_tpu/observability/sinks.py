@@ -41,17 +41,24 @@ class Sink:
         pass
 
 
+def _coerce(v):
+    """One JSON-safe value: numpy/jax scalars unboxed, containers recursed
+    (perf decomposition records nest phase/contributor dicts), everything
+    else stringified."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if hasattr(v, "item"):
+        return v.item()
+    if isinstance(v, dict):
+        return {str(k): _coerce(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_coerce(x) for x in v]
+    return str(v)
+
+
 def _jsonable(record: dict) -> dict:
     """Coerce numpy/jax scalars so json/csv writers never choke."""
-    out = {}
-    for k, v in record.items():
-        if v is None or isinstance(v, (bool, int, float, str)):
-            out[k] = v
-        elif hasattr(v, "item"):
-            out[k] = v.item()
-        else:
-            out[k] = str(v)
-    return out
+    return {k: _coerce(v) for k, v in record.items()}
 
 
 class JsonlSink(Sink):

@@ -117,6 +117,31 @@ def _per_device_bytes(terms: dict, fsdp: int, mp: int, pp: int, seq: int,
     return moments + grads + weights + terms["act"] / (mpp * max(seq, 1))
 
 
+def predicted_step_bytes(model: dict, degrees: dict | None = None,
+                         micro_batch: int = 1,
+                         recompute: str | None = "dots") -> float:
+    """Per-device HBM high-water PREDICTION for an active config.
+
+    The public face of ``_per_device_bytes`` for the observability layer
+    (``observability/memory.py``): the measured peak from
+    ``device.memory_stats()`` is scored against this number as
+    ``hbm_model_error``, closing the loop on the model that decides
+    offload and stage escalation (``suggest_layout`` / ``offload_is_needed``
+    plan with exactly these bytes). ``degrees`` is a ``Distributed``-style
+    dict (``fsdp_degree``/``mp_degree``/``pp_degree``/``seq_degree`` +
+    optional ``sharding`` sub-dict); absent axes default to 1.
+    """
+    deg = dict(degrees or {})
+    sh = deg.get("sharding") or {}
+    fsdp = int(deg.get("fsdp_degree") or sh.get("sharding_degree") or 1)
+    stage = int(sh.get("sharding_stage") or (2 if fsdp > 1 else 0))
+    terms = estimate_memory_terms(model, micro_batch, recompute)
+    return _per_device_bytes(
+        terms, fsdp, int(deg.get("mp_degree") or 1),
+        int(deg.get("pp_degree") or 1), int(deg.get("seq_degree") or 1),
+        stage)
+
+
 def advice_inputs(config: dict,
                   data_world: int | None = None) -> tuple[dict, int, str | None]:
     """(model dict, micro batch, recompute granularity) for the memory
@@ -150,15 +175,10 @@ def offload_is_needed(model: dict, degrees: dict, micro_batch: int = 1,
     a config that fits without it should keep it off. The engine warns on
     that mismatch (``eager_engine.py``). Applies the planner's workspace
     slack (``_HBM_BUDGET_FRACTION``) so the advice and the plan agree on
-    what "fits" means."""
-    terms = estimate_memory_terms(model, micro_batch, recompute)
-    sh = degrees.get("sharding") or {}
-    f = int(degrees.get("fsdp_degree") or sh.get("sharding_degree") or 1)
-    stage = int(sh.get("sharding_stage") or (2 if f > 1 else 0))
-    per_dev = _per_device_bytes(
-        terms, f, int(degrees.get("mp_degree") or 1),
-        int(degrees.get("pp_degree") or 1),
-        int(degrees.get("seq_degree") or 1), stage)
+    what "fits" means. Shares ``predicted_step_bytes`` with the HBM
+    monitor's ``hbm_model_error`` so the offload decision and the
+    measured-peak scoring can never use two drifting byte models."""
+    per_dev = predicted_step_bytes(model, degrees, micro_batch, recompute)
     return per_dev > hbm_gb * (1 << 30) * _HBM_BUDGET_FRACTION
 
 

@@ -646,6 +646,10 @@ def test_gang_off_keeps_pre_gang_layout(tmp_path, devices8):
         "ts", "step", "epoch", "loss", "step_time", "tokens_per_sec",
         "mfu", "lr", "global_batch_size", "engine", "step_time_ewma",
         "samples_per_sec", "data_stall_frac", "grad_norm",
+        # HBM attribution keys (PR 10, docs/performance.md) — carried by
+        # every record, gang or not; the pin guards against GANG leakage
+        # (rank/world/schema_version stamps), not against new telemetry
+        "hbm_stats", "hbm_peak_bytes", "hbm_model_error",
     }
     for line in (telemetry / "metrics.jsonl").read_text().splitlines():
         assert set(json.loads(line)) == pre_gang_keys

@@ -1,0 +1,688 @@
+"""Performance-introspection suite (docs/performance.md, marker ``perf``):
+trace decomposition + roofline MFU-gap attribution driven by a committed
+2-step fixture trimmed from ``bench_artifacts/trace_gpt.tar.gz``, HBM
+sampling with the CPU ``memory_stats()``-is-None fallback and the
+``hbm_model_error`` loop-closure, the ``ProfilerWindow.on_stop`` wiring,
+and the ``tools/perf_gate.py`` pass / synthetic-regression / schema-only
+contract. Sorts with the other ``zz`` suites so the timeout-bound tier-1
+gate keeps its seed dots."""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fleetx_tpu.observability import perf
+from fleetx_tpu.observability.memory import (MemoryMonitor,
+                                             sample_memory_stats)
+from fleetx_tpu.utils.hardware import gpt_flops_per_token, roofline
+
+pytestmark = pytest.mark.perf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "trace_gpt_2step.json.gz")
+TARBALL = os.path.join(REPO, "bench_artifacts", "trace_gpt.tar.gz")
+
+#: the committed bench config the fixture/tarball were captured with
+_FLOPS_PER_STEP = gpt_flops_per_token(24, 1024, 1024,
+                                      vocab_size=50304) * 8 * 1024
+#: BENCHMARKS.md "Step-time decomposition from the committed trace"
+_BWD_MS_PER_LAYER = 6.38
+
+
+# -------------------------------------------------------------- classifier
+
+def test_classifier_name_beats_category():
+    # a fused matmul writing into a scan-stacked buffer reports
+    # hlo_category "convolution fusion" but its cost is the DUS traffic
+    # the fusion is named after (the BENCHMARKS.md accounting)
+    assert perf.classify_event("bitcast_dynamic-update-slice_fusion.25",
+                               "convolution fusion") == "dus"
+    assert perf.classify_event("constant_dynamic-slice_fusion.34",
+                               "loop fusion") == "dus"
+    assert perf.classify_event("fusion.541", "convolution fusion") \
+        == "matmul"
+    assert perf.classify_event("attn._core_attn.39", "custom-call") \
+        == "flash"
+    assert perf.classify_event("custom-call.6", "custom-call") \
+        == "elementwise"  # non-flash custom calls are not kernels we name
+    assert perf.classify_event("copy.241", "data formatting") == "copy"
+    assert perf.classify_event("rng-bit-generator.6",
+                               "rng-bit-generator") == "rng"
+    assert perf.classify_event("add_add_fusion.76", "loop fusion") \
+        == "elementwise"
+
+
+def test_classifier_collective_axis_attribution():
+    ln = ("%all-reduce.1 = f32[128]{0} all-reduce(f32[128]{0} %x), "
+          "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%sum")
+    assert perf.classify_event("all-reduce.1", "all-reduce", ln,
+                               {"fsdp": 8, "tensor": 2}) \
+        == "collective:fsdp"
+    # ambiguous degree (two axes share it) stays unattributed
+    assert perf.classify_event("all-reduce.1", "all-reduce", ln,
+                               {"fsdp": 8, "data": 8}) == "collective"
+    # no axis table at all
+    assert perf.classify_event("reduce-scatter.3", "") == "collective"
+
+
+# ----------------------------------------------------------------- loading
+
+def test_load_trace_shapes(tmp_path):
+    with gzip.open(FIXTURE, "rt") as f:
+        parsed = json.load(f)
+    assert perf.load_trace(parsed) is parsed          # dict passthrough
+    assert perf.load_trace(FIXTURE)["traceEvents"]    # .json.gz
+    # a jax.profiler output directory: newest plugins/profile dump wins
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    raw = gzip.open(FIXTURE, "rb").read()
+    (d / "host.trace.json.gz").write_bytes(raw)
+    assert perf.load_trace(str(tmp_path))["traceEvents"]
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        perf.load_trace(str(empty))
+
+
+# ----------------------------------------------------- fixture decomposition
+
+def test_decompose_reproduces_benchmarks_table():
+    rep = perf.decompose(FIXTURE)
+    assert rep["n_steps"] == 2
+    bwd, fwd = rep["phases"]["bwd_scan"], rep["phases"]["fwd_scan"]
+    assert bwd["layers"] == 24 and fwd["layers"] == 24
+    # the acceptance bar: the committed hand analysis within 5%
+    assert abs(bwd["ms_per_layer"] - _BWD_MS_PER_LAYER) \
+        < 0.05 * _BWD_MS_PER_LAYER
+    # the 4th-flash-pass finding, mechanically: 1 fwd kernel, 3 bwd
+    assert fwd["flash_passes_per_layer"] == 1.0
+    assert bwd["flash_passes_per_layer"] == 3.0
+    # leaf categories + host gap account for the whole step
+    total = sum(rep["categories_ms_per_step"].values()) \
+        + rep["host_gap_ms_per_step"]
+    assert abs(total - rep["step_ms"]) < 0.01 * rep["step_ms"]
+
+
+def test_mfu_gap_names_dus_and_flash_recompute():
+    rep = perf.analyze(FIXTURE, flops_per_step=_FLOPS_PER_STEP,
+                       roofline=roofline("TPU v5 lite"))
+    gap = rep["mfu_gap"]
+    top3 = [c["name"] for c in gap["contributors"][:3]]
+    assert "dus_traffic" in top3 and "flash_recompute" in top3
+    # contributors are a complete accounting of the measured-vs-ideal gap
+    assert abs(gap["accounted_ms"] - gap["gap_ms"]) < 0.02 * gap["gap_ms"]
+    assert 0.3 < gap["mfu"] < 0.5
+    # flash_recompute ≈ the ~21 ms/step BENCHMARKS.md predicted back
+    rec = next(c for c in gap["contributors"]
+               if c["name"] == "flash_recompute")
+    assert 15.0 < rec["ms_per_step"] < 30.0
+
+
+def test_mfu_gap_divides_roofline_by_device_count():
+    """Multi-device: the decomposed timeline is ONE device's, so the
+    ideal time and the MFU denominator both divide the (per-host) FLOPs
+    across the trace's devices — otherwise the gap clamps to 0 on any
+    mesh wider than one chip (review finding)."""
+    decomp = perf.decompose(FIXTURE)
+    rl = roofline("TPU v5 lite")
+    one = perf.mfu_gap(decomp, flops_per_step=_FLOPS_PER_STEP, roofline=rl)
+    eight = perf.mfu_gap(dict(decomp, n_devices=8),
+                         flops_per_step=_FLOPS_PER_STEP * 8, roofline=rl)
+    assert eight["ideal_step_ms"] == pytest.approx(one["ideal_step_ms"])
+    assert eight["gap_ms"] == pytest.approx(one["gap_ms"])
+    assert eight["mfu"] == pytest.approx(one["mfu"])
+
+
+def test_mfu_gap_without_flops_still_ranks():
+    gap = perf.analyze(FIXTURE)["mfu_gap"]
+    assert gap["ideal_step_ms"] is None and gap["mfu"] is None
+    assert gap["contributors"]  # raw category costs still ranked
+    assert all("share_of_gap" not in c for c in gap["contributors"])
+
+
+def test_decompose_synthetic_collective_trace():
+    """A hand-built 1-step trace: collective time lands per mesh axis."""
+    meta = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 1, "name": "thread_name",
+         "args": {"name": "Steps"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+    ]
+    ln = "replica_groups={{0,1,2,3}}, to_apply=%sum"
+    events = meta + [
+        {"ph": "X", "pid": 3, "tid": 1, "name": "0", "ts": 0.0,
+         "dur": 100.0},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "fusion.1", "ts": 0.0,
+         "dur": 60.0, "args": {"hlo_category": "convolution fusion"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "all-reduce.1", "ts": 60.0,
+         "dur": 30.0, "args": {"hlo_category": "all-reduce",
+                               "long_name": ln}},
+    ]
+    rep = perf.decompose({"traceEvents": events},
+                         axis_sizes={"fsdp": 4, "tensor": 2})
+    cats = rep["categories_ms_per_step"]
+    assert cats["collective:fsdp"] == pytest.approx(0.03)
+    assert cats["matmul"] == pytest.approx(0.06)
+    # 10 µs of the 100 µs step has no device op → host gap
+    assert rep["host_gap_ms_per_step"] == pytest.approx(0.01)
+
+
+# ---------------------------------------------------------- offline CLI
+
+def test_trace_report_cli_acceptance(tmp_path):
+    """The ISSUE acceptance line, run LITERALLY (bare ``--json``): the
+    committed tarball reproduces the BENCHMARKS.md backward figure
+    within 5% and names DUS + the flash recompute pass in the top-3 gap
+    contributors."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         TARBALL, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    # stdout carries the table then the JSON payload
+    rep = json.loads(proc.stdout[proc.stdout.index("\n{") + 1:])
+    out = tmp_path / "report.json"  # the FILE form writes the same report
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         TARBALL, "--json", str(out)],
+        capture_output=True, text=True, cwd=REPO, check=True)
+    assert json.loads(out.read_text())["step_ms"] == rep["step_ms"]
+    bwd = rep["phases"]["bwd_scan"]
+    assert abs(bwd["ms_per_layer"] - _BWD_MS_PER_LAYER) \
+        < 0.05 * _BWD_MS_PER_LAYER
+    top3 = [c["name"] for c in rep["mfu_gap"]["contributors"][:3]]
+    assert "dus_traffic" in top3 and "flash_recompute" in top3
+    assert "bwd_scan" in proc.stdout and "dus_traffic" in proc.stdout
+
+
+def test_trace_report_cli_bad_input(tmp_path):
+    bad = tmp_path / "not_a_trace.json"
+    bad.write_text("{}")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "cannot analyze" in proc.stderr
+
+
+# ------------------------------------------------------------- HBM memory
+
+def test_sample_memory_stats_none_on_cpu():
+    # the graceful-degradation contract this whole layer leans on: the
+    # CPU backend reports nothing, and that must surface as None (never
+    # a fake zero)
+    assert sample_memory_stats() is None
+
+
+def test_memory_monitor_unavailable_marker():
+    mon = MemoryMonitor(predicted_bytes=1 << 30, stats_fn=lambda: None)
+    assert mon.sample("post_compile") is None
+    assert mon.available is False
+    assert mon.record_keys() == {"hbm_stats": "unavailable",
+                                 "hbm_peak_bytes": None,
+                                 "hbm_model_error": None}
+    snap = mon.snapshot()
+    assert snap["available"] is False and snap["model_error"] is None
+
+
+def test_memory_monitor_model_error():
+    from fleetx_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    samples = iter([
+        {"bytes_in_use": 800, "peak_bytes_in_use": 900,
+         "bytes_limit": 2000},
+        {"bytes_in_use": 700, "peak_bytes_in_use": 1100,
+         "bytes_limit": 2000},
+    ])
+    mon = MemoryMonitor(registry=reg, predicted_bytes=1000.0,
+                        stats_fn=lambda: next(samples))
+    mon.sample("post_compile")
+    assert mon.peak_bytes == 900
+    assert mon.model_error() == pytest.approx(-0.1)
+    mon.sample("steady_state")
+    assert mon.peak_bytes == 1100  # monotone max across phases
+    assert mon.model_error() == pytest.approx(0.1)
+    keys = mon.record_keys()
+    assert keys["hbm_stats"] == "ok" and keys["hbm_peak_bytes"] == 1100
+    assert keys["hbm_model_error"] == pytest.approx(0.1)
+    assert reg.gauge("hbm_peak_bytes").value == 1100
+    assert reg.gauge("hbm_model_error").value == pytest.approx(0.1)
+    assert reg.gauge("hbm_peak_bytes.steady_state").value == 1100
+    assert mon.snapshot()["phases"]["post_compile"]["bytes_in_use"] == 800
+
+
+def test_memory_monitor_flaky_read_keeps_available():
+    samples = iter([{"peak_bytes_in_use": 10}, None,
+                    {"peak_bytes_in_use": 20}])
+    mon = MemoryMonitor(stats_fn=lambda: next(samples))
+    mon.sample("a")
+    mon.sample("b")  # one failed read must not demote the backend
+    assert mon.available is True
+    mon.sample("c")
+    assert mon.peak_bytes == 20
+
+
+def test_predicted_step_bytes_degrees():
+    from fleetx_tpu.parallel.auto_layout import (estimate_memory_terms,
+                                                 predicted_step_bytes)
+
+    model = {"hidden_size": 1024, "num_layers": 24, "vocab_size": 50304,
+             "max_position_embeddings": 1024}
+    flat = predicted_step_bytes(model, {}, micro_batch=8, recompute="dots")
+    assert flat == pytest.approx(
+        sum(estimate_memory_terms(model, 8, "dots").values()))
+    # stage-2 fsdp sharding shrinks moments+grads, not weights/act
+    sharded = predicted_step_bytes(
+        model, {"fsdp_degree": 8,
+                "sharding": {"sharding_stage": 2, "sharding_degree": 8}},
+        micro_batch=8, recompute="dots")
+    assert sharded < flat
+
+
+# -------------------------------------------------- engine + profiler hook
+
+VOCAB, SEQ, BATCH = 128, 32, 8
+
+
+def _perf_engine(tmp_path, devices, max_steps=2):
+    from fleetx_tpu.core.engine import EagerEngine
+    from fleetx_tpu.core.module import GPTModule
+    from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+    from fleetx_tpu.optims.optimizer import build_optimizer
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    cfg = {
+        "Model": dict(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_attention_heads=4, max_position_embeddings=SEQ,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      use_flash_attention=False, dtype="float32",
+                      param_dtype="float32"),
+        "Engine": {"max_steps": max_steps, "logging_freq": 1,
+                   "eval_freq": 0,
+                   "save_load": {"output_dir": str(tmp_path / "ckpt")}},
+        "Global": {"seed": 7},
+        "Observability": {"enable": True,
+                          "output_dir": str(tmp_path / "telemetry"),
+                          "trace": {"enable": False}},
+    }
+    module = GPTModule(cfg)
+    lr = build_lr_scheduler({"max_lr": 1e-3, "warmup_steps": 1,
+                             "decay_steps": 10})
+    opt = build_optimizer({"name": "AdamW"}, lr)
+    return EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr,
+                       mesh=build_mesh({}, devices=devices))
+
+
+def _batches(n):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        tokens = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+        out.append({
+            "tokens": tokens,
+            "position_ids": np.broadcast_to(
+                np.arange(SEQ, dtype=np.int32), (BATCH, SEQ)).copy(),
+            "labels": tokens,
+            "loss_mask": np.ones((BATCH, SEQ), np.float32)})
+    return out
+
+
+def test_cpu_fit_records_unavailable_marker(tmp_path, devices8):
+    """The acceptance path: a CPU-mesh fit (memory_stats() is None) emits
+    the explicit unavailable marker, schema-valid, with the auto_layout
+    prediction still computed."""
+    from fleetx_tpu.observability.schema import validate_jsonl
+
+    eng = _perf_engine(tmp_path, devices8[:1])
+    eng.fit(_batches(2))
+    eng.obs.close()
+    assert eng.mem is not None and eng.mem.available is False
+    assert eng.mem.predicted_bytes and eng.mem.predicted_bytes > 0
+    path = str(tmp_path / "telemetry" / "metrics.jsonl")
+    count, errors = validate_jsonl(path)
+    assert errors == [] and count == 2
+    for rec in (json.loads(l) for l in open(path)):
+        assert rec["hbm_stats"] == "unavailable"
+        assert rec["hbm_peak_bytes"] is None
+        assert rec["hbm_model_error"] is None
+
+
+def test_cpu_fit_records_model_error_with_stats(tmp_path, devices8,
+                                                monkeypatch):
+    """With a stats-reporting backend (faked on the CPU mesh) every
+    window record carries hbm_model_error — the loop-closure on the
+    auto_layout memory model."""
+    import fleetx_tpu.observability.memory as memory_mod
+
+    eng = _perf_engine(tmp_path, devices8[:1])
+    fake = {"bytes_in_use": 1 << 20, "peak_bytes_in_use": 1 << 21,
+            "bytes_limit": 1 << 30}
+    monkeypatch.setattr(memory_mod, "sample_memory_stats",
+                        lambda device=None: dict(fake))
+    eng.fit(_batches(2))
+    eng.obs.close()
+    assert eng.mem.available is True
+    expected = (float(1 << 21) - eng.mem.predicted_bytes) \
+        / eng.mem.predicted_bytes
+    records = [json.loads(l) for l in
+               open(tmp_path / "telemetry" / "metrics.jsonl")]
+    for rec in records:
+        assert rec["hbm_stats"] == "ok"
+        assert rec["hbm_peak_bytes"] == 1 << 21
+        assert rec["hbm_model_error"] == pytest.approx(expected, abs=1e-3)
+    assert eng.obs.registry.gauge("hbm_model_error").value \
+        == pytest.approx(expected, abs=1e-4)
+
+
+def test_profiler_window_on_stop_hook(monkeypatch):
+    import jax
+
+    from fleetx_tpu.observability.trace import ProfilerWindow
+
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    calls = []
+    pw = ProfilerWindow({"enable": True, "start_step": 0, "stop_step": 1,
+                         "output_dir": "/tmp/pw"})
+    pw.on_stop = calls.append
+    assert pw.maybe_start(0)
+    assert pw.maybe_stop(1)
+    assert calls == ["/tmp/pw"]
+
+    # a raising hook must not propagate out of stop()
+    pw.arm()
+    pw.on_stop = lambda d: (_ for _ in ()).throw(RuntimeError("boom"))
+    assert pw.maybe_start(0)
+    assert pw.maybe_stop(1)  # no raise
+
+
+def test_engine_on_profiler_stop_emits_perf_record(tmp_path, devices8):
+    """The tentpole wiring: a closed profiler window lands a
+    decomposition record in the perf stream + the gauges (driven with
+    the committed fixture as the 'dumped' trace)."""
+    eng = _perf_engine(tmp_path, devices8[:1])
+    eng.fit(_batches(2))
+    eng._on_profiler_stop(FIXTURE)
+    eng.obs.flush()
+    assert eng._perf_report is not None
+    assert eng.obs.registry.gauge("perf_bwd_scan_ms_per_layer").value \
+        == pytest.approx(_BWD_MS_PER_LAYER, rel=0.05)
+    perf_path = tmp_path / "telemetry" / "perf.jsonl"
+    records = [json.loads(l) for l in open(perf_path)]
+    assert len(records) == 1
+    assert records[0]["phases"]["bwd_scan"]["layers"] == 24
+    assert records[0]["hbm"]["available"] is False  # CPU mesh
+    eng.obs.close()
+
+
+def test_engine_on_profiler_stop_never_raises(tmp_path, devices8):
+    eng = _perf_engine(tmp_path, devices8[:1])
+    eng.prepare(_batches(1)[0])
+    eng._on_profiler_stop(str(tmp_path / "no_such_dir"))  # logs, no raise
+    assert eng._perf_report is None
+    eng.obs.close()
+
+
+# ---------------------------------------------------------------- perf gate
+
+def _gate(argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py")]
+        + argv, capture_output=True, text=True, cwd=REPO)
+
+
+def test_perf_gate_passes_on_committed_baseline(tmp_path):
+    base = json.load(open(os.path.join(REPO, "BENCH_SELF.json")))
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(base["results"]["gpt"]))
+    proc = _gate([str(fresh), "--baseline", "BENCH_SELF.json:gpt"])
+    assert proc.returncode == 0, proc.stderr
+    assert "perf gate: pass" in proc.stdout
+
+
+def test_perf_gate_fails_synthetic_regression(tmp_path):
+    base = json.load(open(os.path.join(REPO, "BENCH_SELF.json")))
+    entry = dict(base["results"]["gpt"])
+    entry["value"] = entry["value"] * 0.9  # the acceptance drill: −10%
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(entry))
+    proc = _gate([str(fresh), "--baseline", "BENCH_SELF.json:gpt"])
+    assert proc.returncode == 1
+    assert "REGRESSION" in proc.stderr and "FAIL" in proc.stdout
+
+
+def test_perf_gate_missing_baseline(tmp_path):
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps({"metric": "nope", "value": 1.0}))
+    proc = _gate([str(fresh), "--baseline", "BENCH_SELF.json:absent"])
+    assert proc.returncode == 2
+    proc = _gate([str(fresh)])  # auto-match finds nothing either
+    assert proc.returncode == 2
+    assert "no entry" in proc.stderr
+
+
+def test_perf_gate_refuses_ambiguous_auto_match(tmp_path):
+    """gpt and gpt_trace (and the traced A/Bs) share one metric string:
+    auto-match must refuse and demand FILE:KEY rather than silently
+    gating a variant against the oldest, slowest entry."""
+    base = json.load(open(os.path.join(REPO, "BENCH_SELF.json")))
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(base["results"]["gpt"]))
+    proc = _gate([str(fresh)])
+    assert proc.returncode == 2
+    assert "matches 2 entries" in proc.stderr
+    assert "gpt_trace" in proc.stderr
+
+
+def test_perf_gate_schema_only_is_the_repo_gate():
+    """The CI contract (alongside tools/lint.py): with no fresh chip
+    numbers, --schema-only validates the committed baseline and
+    self-checks the gate logic, exit 0."""
+    proc = _gate(["--schema-only"])
+    assert proc.returncode == 0, proc.stderr
+    assert "self-check passed" in proc.stdout
+    proc = _gate([])  # the no-argument form is the same mode
+    assert proc.returncode == 0
+
+
+def test_perf_gate_compare_semantics():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    base = {"value": 1000.0, "step_time_s": 0.25,
+            "span_means_ms": {"data_fetch": 0.1},
+            "data_stall_frac": 0.0}
+    # within band: −4% tokens/s passes, +4% step time passes
+    fresh = dict(base, value=960.0, step_time_s=0.26)
+    rows = {r["metric"]: r for r in perf_gate.compare(fresh, base)}
+    assert rows["value"]["verdict"] == "pass"
+    assert rows["step_time_s"]["verdict"] == "pass"
+    # beyond band: −6% tokens/s fails; a 0.4 ms span bump stays inside
+    # the 0.5 ms absolute floor (noise, not regression)
+    fresh = dict(base, value=940.0,
+                 span_means_ms={"data_fetch": 0.5})
+    rows = {r["metric"]: r for r in perf_gate.compare(fresh, base)}
+    assert rows["value"]["verdict"] == "FAIL"
+    assert rows["span_means_ms.data_fetch"]["verdict"] == "pass"
+    # data_stall uses the absolute band (baseline 0 → rel is meaningless)
+    rows = {r["metric"]: r
+            for r in perf_gate.compare(dict(base, data_stall_frac=0.2),
+                                       base)}
+    assert rows["data_stall_frac"]["verdict"] == "FAIL"
+    # absent on one side → skip, never KeyError (pre-PR-10 baselines)
+    rows = {r["metric"]: r
+            for r in perf_gate.compare(dict(base, hbm_peak_bytes=5), base)}
+    assert rows["hbm_peak_bytes"]["verdict"] == "skip"
+
+
+# ------------------------------------------------------- satellites & misc
+
+def test_roofline_calibration():
+    rl = roofline("TPU v5 lite")
+    assert rl["peak_flops"] == pytest.approx(197e12)
+    assert rl["matmul_flops"] == pytest.approx(160.5e12)  # calibrated
+    assert rl["hbm_bytes_per_s"] == pytest.approx(1.6e12)
+    rl = roofline("TPU v5p")
+    assert rl["matmul_flops"] == rl["peak_flops"] == pytest.approx(459e12)
+    assert roofline("cpu") is None and roofline("") is None
+
+
+def test_observability_perf_config_validation():
+    from fleetx_tpu.utils.config import (AttrDict,
+                                         process_observability_config)
+
+    cfg = AttrDict({"Observability": AttrDict(
+        {"enable": True, "perf": AttrDict({"top_k": 0})})})
+    with pytest.raises(ValueError, match="perf.top_k"):
+        process_observability_config(cfg)
+    cfg = AttrDict({"Observability": AttrDict(
+        {"enable": True, "perf": AttrDict({"top_k": 3})})})
+    process_observability_config(cfg)  # valid
+
+
+def test_metrics_report_tolerates_pre_pr10_records(tmp_path):
+    """Old records carry no HBM keys: summarize must not KeyError and the
+    table renders em-dashes; new records fill the rows."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_report
+    finally:
+        sys.path.pop(0)
+    old = {"ts": 1.0, "step": 1, "loss": 2.0, "step_time": 0.1,
+           "tokens_per_sec": 100.0, "mfu": None}
+    summ = metrics_report.summarize([old])
+    assert summ["hbm_peak_bytes"] is None
+    assert summ["hbm_model_error"] is None
+    new = dict(old, step=2, ts=2.0, hbm_peak_bytes=1 << 30,
+               hbm_model_error=0.05, hbm_stats="ok")
+    summ = metrics_report.summarize([old, new])
+    assert summ["hbm_peak_bytes"]["mean"] == 1 << 30
+    # --compare against a pre-PR-10 bench entry (no hbm keys): no error
+    assert metrics_report.compare(
+        summ, os.path.join(REPO, "BENCH_SELF.json") + ":gpt") == 0
+
+
+def test_perf_sink_is_rank_suffixed(tmp_path, monkeypatch):
+    """Every rank may close a profiler window: non-zero ranks write
+    perf.rank<i>.jsonl like the tracer path, never the shared file
+    (review finding)."""
+    import fleetx_tpu.observability as obs_mod
+
+    monkeypatch.setattr(obs_mod, "_process_index", lambda: 1)
+    obs = obs_mod.Observability({"enable": True,
+                                 "output_dir": str(tmp_path),
+                                 "trace": {"enable": False}})
+    obs.rank = 1  # the facade captured the patched index at init anyway
+    obs.emit_perf({"step_ms": 1.0, "phases": {}, "mfu_gap": {}})
+    obs.close()
+    assert os.path.exists(tmp_path / "perf.rank1.jsonl")
+    assert not os.path.exists(tmp_path / "perf.jsonl")
+
+
+def test_tpu_watch_traced_sweep_keeps_timing_untraced(tmp_path,
+                                                      monkeypatch):
+    """The A/B stance: timing children run WITHOUT the profiler armed
+    (its ~1% must not land on one side of the delta); the winner re-runs
+    once traced and its decomposition attaches under 'traced'."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import tpu_watch
+    finally:
+        sys.path.pop(0)
+    art = tmp_path / "bench_artifacts"
+    art.mkdir()
+    monkeypatch.setattr(tpu_watch, "ART", str(art))
+    monkeypatch.setattr(tpu_watch, "LOG", str(art / "watch.log"))
+    calls = []
+
+    def fake_run_child(name, argv, env_extra, timeout=1200.0):
+        calls.append((name, dict(env_extra)))
+        res = {"value": 100.0, "device_kind": "TPU v5 lite",
+               "batch_size": 8}
+        trace_dir = env_extra.get("FLEETX_BENCH_TRACE")
+        if trace_dir:
+            dump = os.path.join(trace_dir, "plugins", "profile", "x")
+            os.makedirs(dump)
+            with open(FIXTURE, "rb") as f:
+                open(os.path.join(dump, "vm.trace.json.gz"),
+                     "wb").write(f.read())
+            res["decomposition"] = {"step_ms": 251.2}
+        return res, None
+
+    monkeypatch.setattr(tpu_watch, "run_child", fake_run_child)
+    state = {}
+    tpu_watch._traced_sweep(
+        state, "gpt_policyfix",
+        [("", {"FLEETX_BENCH_RECOMPUTE": "dots"}, {})])
+    timing = [c for c in calls if c[0] == "gpt_policyfix"]
+    traced = [c for c in calls if c[0] == "gpt_policyfix_trace"]
+    assert len(timing) == 1 and len(traced) == 1
+    assert "FLEETX_BENCH_TRACE" not in timing[0][1]
+    assert "FLEETX_BENCH_TRACE" in traced[0][1]
+    res = state["gpt_policyfix"]
+    assert "_env" not in res and "_trace_dir" not in res
+    assert res["traced"]["decomposition"] == {"step_ms": 251.2}
+    assert res["trace"] == "bench_artifacts/trace_gpt_policyfix.tar.gz"
+    assert res["trace_report"] == \
+        "bench_artifacts/trace_gpt_policyfix.report.json"
+    assert not (art / "trace_gpt_policyfix").exists()
+
+
+def test_tpu_watch_finalize_trace(tmp_path, monkeypatch):
+    """The watcher satellite: a capture's raw profiler dump is tarred,
+    trace_report --json runs offline on it, and the raw dirs are removed
+    so commit_artifacts never stages loose xplane files."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import tpu_watch
+    finally:
+        sys.path.pop(0)
+    art = tmp_path / "bench_artifacts"
+    art.mkdir()
+    monkeypatch.setattr(tpu_watch, "ART", str(art))
+    monkeypatch.setattr(tpu_watch, "LOG", str(art / "watch.log"))
+    dump = art / "trace_gpt_policyfix" / "plugins" / "profile" / "x"
+    dump.mkdir(parents=True)
+    (dump / "vm.trace.json.gz").write_bytes(open(FIXTURE, "rb").read())
+    loser = art / "trace_gpt_policyfix_2"
+    loser.mkdir()
+    state = {"gpt_policyfix": {
+        "value": 1.0, "batch_size": 8,
+        "_trace_dir": str(art / "trace_gpt_policyfix")}}
+    tpu_watch._finalize_trace(state, "gpt_policyfix")
+    res = state["gpt_policyfix"]
+    assert "_trace_dir" not in res
+    assert res["trace"] == "bench_artifacts/trace_gpt_policyfix.tar.gz"
+    assert res["trace_report"] == \
+        "bench_artifacts/trace_gpt_policyfix.report.json"
+    rep = json.loads((art / "trace_gpt_policyfix.report.json").read_text())
+    assert rep["phases"]["bwd_scan"]["layers"] == 24
+    assert not (art / "trace_gpt_policyfix").exists()  # raw dirs removed
+    assert not loser.exists()
+    # a capture with no dump (failed child) is a clean no-op
+    state2 = {"gpt_unroll": {"value": 2.0}}
+    tpu_watch._finalize_trace(state2, "gpt_unroll")
+    assert state2["gpt_unroll"] == {"value": 2.0}
+
+
+def test_perf_summary_shape():
+    rep = perf.analyze(FIXTURE, flops_per_step=_FLOPS_PER_STEP,
+                       roofline=roofline("TPU v5 lite"))
+    slim = perf.summary(rep)
+    assert slim["bwd_scan_ms_per_layer"] == pytest.approx(
+        _BWD_MS_PER_LAYER, rel=0.05)
+    assert len(slim["top_contributors"]) == 3
+    assert {"name", "ms_per_step"} <= set(slim["top_contributors"][0])

@@ -69,6 +69,13 @@ def summarize(records: list[dict]) -> dict:
         "mfu": _stats([r.get("mfu") for r in records]),
         "data_stall_frac": _stats([r.get("data_stall_frac")
                                    for r in records]),
+        # HBM attribution keys (docs/performance.md) — PR-10 records only;
+        # .get() tolerates their absence in older runs (stats stay None
+        # and the table shows em-dashes instead of KeyError-ing)
+        "hbm_peak_bytes": _stats([r.get("hbm_peak_bytes")
+                                  for r in records]),
+        "hbm_model_error": _stats([r.get("hbm_model_error")
+                                   for r in records]),
     }
     return summary
 
@@ -79,6 +86,8 @@ _ROWS = (
     ("tokens_per_sec", "tokens/s", 1.0, "{:,.0f}"),
     ("mfu", "MFU", 100.0, "{:.2f}%"),
     ("data_stall_frac", "data stall", 100.0, "{:.2f}%"),
+    ("hbm_peak_bytes", "HBM peak (GB)", 1.0 / (1 << 30), "{:.3f}"),
+    ("hbm_model_error", "HBM model err", 100.0, "{:+.1f}%"),
 )
 
 
@@ -119,6 +128,18 @@ def compare(summary: dict, spec: str) -> int:
     print(f"\nvs {path}:{key} ({entry.get('metric', '?')}): "
           f"{tps['mean']:,.0f} / {ref:,.0f} {entry.get('unit', '')} "
           f"= {ratio:.3f}x")
+    # the PR-10 keys diff too when BOTH sides carry them; absence on
+    # either side (pre-PR-10 bench entries, CPU runs with stats
+    # unavailable) is silently tolerated — never a KeyError, never a
+    # fake-zero comparison
+    for skey, ekey, label in (("mfu", "mfu", "MFU"),
+                              ("hbm_peak_bytes", "hbm_peak_bytes",
+                               "HBM peak")):
+        st, ref_v = summary.get(skey), entry.get(ekey)
+        if not st or not isinstance(ref_v, (int, float)) or not ref_v:
+            continue
+        print(f"   {label}: {st['mean']:.4g} / {ref_v:.4g} "
+              f"= {st['mean'] / ref_v:.3f}x")
     return 0
 
 

@@ -1,0 +1,291 @@
+"""Perf regression gate: fresh bench JSON vs committed baselines.
+
+Usage::
+
+    python tools/perf_gate.py fresh.json                       # auto-match
+    python tools/perf_gate.py fresh.json --baseline BENCH_SELF.json:gpt
+    python tools/perf_gate.py --schema-only                    # CPU CI mode
+    python tools/perf_gate.py                                  # = schema-only
+
+Compares the metrics ``bench.py`` emits against a committed
+``BENCH_SELF.json`` entry with per-metric, noise-aware tolerance bands
+(``GATE_METRICS``): direction-aware (tokens/s regress DOWN, step time
+regresses UP), relative bands sized to the observed capture-to-capture
+jitter (the committed ``gpt`` vs ``gpt_trace`` pair differs ~1%; the
+default 5% band is 5× that), and absolute floors so sub-millisecond span
+means aren't failed on scheduler noise. Prints a verdict table and exits
+non-zero on any regression — the bench pipeline's analogue of
+``tools/lint.py``.
+
+``--schema-only`` (and the no-argument form) is the repo-gate mode for
+hosts with no fresh chip numbers (CPU CI): it validates the baseline
+file's shape and self-checks the gate logic — an identical copy must
+PASS, a synthetic 10% tokens/s regression must FAIL — so the gate itself
+is regression-tested on every run. Exit codes follow ``tools/lint.py``:
+0 clean, 1 regression (or self-check failure), 2 usage error.
+
+Updating baselines: commit a new capture via ``tools/tpu_watch.py``
+(which rewrites ``BENCH_SELF.json``) — never hand-edit a number to make
+the gate pass (docs/performance.md "Gate thresholds").
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "BENCH_SELF.json")
+
+#: metric → (direction, relative tolerance, absolute floor).
+#: direction "higher" = larger is better (regression when fresh drops
+#: below base×(1−tol)); "lower" = smaller is better. The absolute floor
+#: is in the metric's own unit and wins for tiny baselines where a
+#: relative band is all jitter.
+GATE_METRICS = {
+    "value": ("higher", 0.05, 0.0),            # tokens/s (the headline)
+    "mfu": ("higher", 0.05, 0.0),
+    "step_time_s": ("lower", 0.05, 0.0),
+    "fit_step_time_s": ("lower", 0.08, 0.0),
+    "data_stall_frac": ("lower", 0.0, 0.05),   # abs band: baseline ~0
+    "hbm_peak_bytes": ("lower", 0.10, 0.0),
+    "hbm_model_error": ("lower", 0.0, 0.10),   # abs: it's already relative
+}
+#: per-phase span means are noisier than the headline (host scheduling):
+#: wide relative band + a 0.5 ms absolute floor
+SPAN_TOL = ("lower", 0.25, 0.5)
+#: decomposition per-layer times (present when the capture carried a
+#: profiler trace — docs/performance.md)
+DECOMP_METRICS = {
+    "decomposition.bwd_scan_ms_per_layer": ("lower", 0.10, 0.05),
+    "decomposition.fwd_scan_ms_per_layer": ("lower", 0.10, 0.05),
+    "decomposition.gap_ms": ("lower", 0.15, 1.0),
+}
+
+
+def _get_path(d: dict, dotted: str):
+    """Nested lookup by dotted path, None when any hop is absent."""
+    node = d
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _numeric(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def compare(fresh: dict, base: dict,
+            overrides: dict | None = None) -> list[dict]:
+    """Row per gate metric present in BOTH dicts → verdict table rows.
+
+    A metric missing from either side is reported as ``skip`` (pre-PR-10
+    baselines carry no HBM/decomposition keys — absence is not a
+    regression), never silently dropped from the table.
+    """
+    specs = dict(GATE_METRICS)
+    specs.update(DECOMP_METRICS)
+    for key in sorted(set(list((base.get("span_means_ms") or {}))
+                          + list((fresh.get("span_means_ms") or {})))):
+        specs[f"span_means_ms.{key}"] = SPAN_TOL
+    specs.update(overrides or {})
+
+    rows = []
+    for metric, (direction, rel, floor) in specs.items():
+        b, f = _numeric(_get_path(base, metric)), \
+            _numeric(_get_path(fresh, metric))
+        if b is None or f is None:
+            rows.append({"metric": metric, "base": b, "fresh": f,
+                         "verdict": "skip"})
+            continue
+        band = max(abs(b) * rel, floor)
+        delta = f - b
+        regressed = (delta < -band) if direction == "higher" \
+            else (delta > band)
+        rows.append({
+            "metric": metric, "base": b, "fresh": f,
+            "delta": round(delta, 6),
+            "delta_pct": round(delta / b * 100.0, 2) if b else None,
+            "band": round(band, 6), "direction": direction,
+            "verdict": "FAIL" if regressed else "pass",
+        })
+    return rows
+
+
+def print_table(rows: list[dict]) -> None:
+    """Render the verdict table (skips compressed to one line)."""
+    hdr = f"{'metric':<38} {'baseline':>12} {'fresh':>12} {'Δ%':>8} " \
+          f"{'verdict':>8}"
+    print(hdr)
+    print("-" * len(hdr))
+    skipped = []
+    for r in rows:
+        if r["verdict"] == "skip":
+            skipped.append(r["metric"])
+            continue
+        pct = r.get("delta_pct")
+        print(f"{r['metric']:<38} {r['base']:>12,.4g} {r['fresh']:>12,.4g} "
+              f"{(f'{pct:+.1f}' if pct is not None else '—'):>8} "
+              f"{r['verdict']:>8}")
+    if skipped:
+        print(f"skipped (absent on one side): {', '.join(skipped)}")
+
+
+def _load_entry(spec: str) -> dict:
+    """``FILE[:KEY]`` → one bench-result dict (BENCH_*.json or raw)."""
+    path, _, key = spec.partition(":")
+    with open(path) as f:
+        payload = json.load(f)
+    results = payload.get("results", payload)
+    if key:
+        entry = results.get(key)
+        if not isinstance(entry, dict) or "value" not in entry:
+            raise KeyError(
+                f"no result entry {key!r} with a 'value' in {path}")
+        return entry
+    return payload
+
+
+def _load_fresh(path: str) -> dict:
+    """A fresh bench JSON: a file whose LAST JSON line/object wins (the
+    bench.py contract is exactly one JSON line on stdout)."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        for line in reversed(text.splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    raise ValueError(f"{path} contains no JSON object")
+
+
+def _match_keys(fresh: dict, baseline_path: str) -> list[str]:
+    """Auto-match: ALL baseline results entries sharing fresh's 'metric'.
+
+    Returns every hit so the caller can refuse ambiguity: BENCH_SELF
+    holds several captures of the same bench config under one metric
+    string (gpt / gpt_trace / the traced A/Bs), and silently gating a
+    variant against the first — typically the oldest, slowest — entry
+    would let a real regression hide inside the inter-entry spread.
+    """
+    with open(baseline_path) as f:
+        payload = json.load(f)
+    return [key for key, entry in (payload.get("results") or {}).items()
+            if isinstance(entry, dict)
+            and entry.get("metric") == fresh.get("metric")]
+
+
+def self_check(baseline_entry: dict) -> list[str]:
+    """The gate's own regression test (schema-only mode): identical copy
+    PASSES, a synthetic −10% tokens/s copy FAILS. Returns problems."""
+    problems = []
+    rows = compare(dict(baseline_entry), baseline_entry)
+    if any(r["verdict"] == "FAIL" for r in rows):
+        problems.append("identical copy flagged as regression")
+    if not any(r["verdict"] == "pass" for r in rows):
+        problems.append("identical copy compared zero metrics")
+    regressed = dict(baseline_entry)
+    regressed["value"] = float(baseline_entry["value"]) * 0.9
+    rows = compare(regressed, baseline_entry)
+    if not any(r["metric"] == "value" and r["verdict"] == "FAIL"
+               for r in rows):
+        problems.append("synthetic 10% tokens/s regression NOT caught")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate a fresh bench JSON against committed baselines")
+    ap.add_argument("fresh", nargs="?",
+                    help="fresh bench JSON file (bench.py output); omit "
+                         "for schema-only mode")
+    ap.add_argument("--baseline", default=None, metavar="FILE[:KEY]",
+                    help=f"baseline entry (default {DEFAULT_BASELINE} with "
+                         "the entry auto-matched by 'metric')")
+    ap.add_argument("--schema-only", action="store_true",
+                    help="validate baselines + self-check the gate logic "
+                         "without fresh chip numbers (CPU CI mode)")
+    ap.add_argument("--json", metavar="OUT", nargs="?", const="-",
+                    default=None,
+                    help="write the verdict rows as JSON to OUT "
+                         "(bare --json streams to stdout)")
+    args = ap.parse_args(argv)
+
+    base_spec = args.baseline or DEFAULT_BASELINE
+    if args.schema_only or not args.fresh:
+        path = base_spec.partition(":")[0]
+        if not os.path.exists(path):
+            print(f"error: baseline {path} not found", file=sys.stderr)
+            return 2
+        try:
+            entry = _load_entry(base_spec if ":" in base_spec
+                                else f"{path}:gpt")
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: bad baseline: {e}", file=sys.stderr)
+            return 2
+        problems = self_check(entry)
+        if problems:
+            print("perf_gate self-check FAILED:", file=sys.stderr)
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+            return 1
+        print(f"perf_gate schema-only: baseline {path} OK, gate logic "
+              f"self-check passed ({len(GATE_METRICS)} headline metrics)")
+        return 0
+
+    try:
+        fresh = _load_fresh(args.fresh)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        if ":" in base_spec:
+            base = _load_entry(base_spec)
+        else:
+            keys = _match_keys(fresh, base_spec)
+            if not keys:
+                print(f"error: no entry in {base_spec} matches metric "
+                      f"{fresh.get('metric')!r} — pass --baseline FILE:KEY",
+                      file=sys.stderr)
+                return 2
+            if len(keys) > 1:
+                print(f"error: metric {fresh.get('metric')!r} matches "
+                      f"{len(keys)} entries in {base_spec} "
+                      f"({', '.join(keys)}) — pass --baseline FILE:KEY to "
+                      f"pick the A/B you are gating against",
+                      file=sys.stderr)
+                return 2
+            print(f"baseline: {base_spec}:{keys[0]}")
+            base = _load_entry(f"{base_spec}:{keys[0]}")
+    except (OSError, KeyError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: bad baseline: {e}", file=sys.stderr)
+        return 2
+
+    rows = compare(fresh, base)
+    print_table(rows)
+    if args.json:
+        payload = json.dumps({"rows": rows}, indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    failed = [r for r in rows if r["verdict"] == "FAIL"]
+    if failed:
+        print(f"\nREGRESSION: {len(failed)} metric(s) outside their "
+              f"tolerance band", file=sys.stderr)
+        return 1
+    print("\nperf gate: pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

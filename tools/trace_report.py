@@ -1,0 +1,161 @@
+"""Offline trace decomposition: the BENCHMARKS.md table, mechanically.
+
+Usage::
+
+    python tools/trace_report.py bench_artifacts/trace_gpt.tar.gz
+    python tools/trace_report.py bench_artifacts/trace_gpt.tar.gz --json -
+    python tools/trace_report.py <jax.profiler output dir> --json report.json
+    python tools/trace_report.py trace.json.gz --batch 4 --seq 2048
+
+Accepts any trace shape ``observability/perf.py`` can load: the committed
+``.tar.gz`` artifacts, a raw Chrome-trace ``.json``/``.json.gz``, or a
+``jax.profiler`` output directory. Defaults describe the repo's canonical
+bench config (GPT-345M, bs8 × seq1024 on the calibrated v5-lite chip) so
+``python tools/trace_report.py bench_artifacts/trace_gpt.tar.gz`` needs no
+flags; pass ``--layers/--hidden/--seq/--batch/--vocab`` (or an explicit
+``--flops-per-step``) for other captures, ``--device-kind`` for other
+chips, and ``--axis-sizes fsdp=8,tensor=2`` to attribute collective time
+per mesh axis. The analysis itself is pure host-side Python
+(``observability/perf.py`` never touches jax) — no accelerator or live
+backend needed, so it runs on the committed artifacts anywhere.
+
+Exit codes follow ``tools/metrics_report.py``: 0 report printed,
+2 usage/load error.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from fleetx_tpu.observability import perf  # noqa: E402
+from fleetx_tpu.utils.hardware import (  # noqa: E402
+    gpt_flops_per_token, roofline)
+
+#: the canonical bench config (bench.py / BENCHMARKS.md): what the
+#: committed ``trace_gpt.tar.gz`` was captured with
+DEFAULTS = {"layers": 24, "hidden": 1024, "seq": 1024, "batch": 8,
+            "vocab": 50304, "device_kind": "TPU v5 lite"}
+
+
+def _parse_axis_sizes(spec: str) -> dict:
+    """``fsdp=8,tensor=2`` → {"fsdp": 8, "tensor": 2}."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        axis, _, size = part.partition("=")
+        if not size:
+            raise ValueError(f"bad --axis-sizes entry {part!r} "
+                             f"(want axis=degree)")
+        out[axis.strip()] = int(size)
+    return out
+
+
+def print_report(report: dict) -> None:
+    """Render the analyze() report as the BENCHMARKS-style text tables."""
+    gap = report.get("mfu_gap", {})
+    print(f"trace: {report['device']}  steps: {report['n_steps']}  "
+          f"step: {report['step_ms']:.1f} ms"
+          + (f"  MFU: {gap['mfu']:.3f}" if gap.get("mfu") else ""))
+
+    print("\nphase decomposition")
+    hdr = f"{'phase':<12} {'ms/step':>9} {'ms/layer':>9} {'layers':>7} " \
+          f"{'flash/layer':>12}"
+    print(hdr)
+    print("-" * len(hdr))
+    for label in ("fwd_scan", "bwd_scan", "extra_scan", "outside"):
+        ph = report.get("phases", {}).get(label)
+        if not ph:
+            continue
+        ml = ph.get("ms_per_layer")
+        fl = ph.get("flash_passes_per_layer")
+        print(f"{label:<12} {ph['ms_per_step']:>9.2f} "
+              f"{(f'{ml:.3f}' if ml is not None else '—'):>9} "
+              f"{ph.get('layers', '—'):>7} "
+              f"{(f'{fl:.1f}' if fl is not None else '—'):>12}")
+
+    print("\ncategory ms/step")
+    for cat, ms in report.get("categories_ms_per_step", {}).items():
+        print(f"  {cat:<14} {ms:>9.2f}")
+    print(f"  {'host_gap':<14} {report.get('host_gap_ms_per_step', 0):>9.2f}")
+
+    if gap:
+        ideal = gap.get("ideal_step_ms")
+        print(f"\nMFU gap: measured {gap['measured_step_ms']:.1f} ms vs "
+              f"roofline {f'{ideal:.1f}' if ideal else '?'} ms → "
+              f"gap {gap.get('gap_ms') if gap.get('gap_ms') is not None else '?'} ms "
+              f"(accounted {gap['accounted_ms']:.1f})")
+        for c in gap.get("contributors", []):
+            share = c.get("share_of_gap")
+            print(f"  {c['name']:<22} {c['ms_per_step']:>8.2f} ms"
+                  + (f"  ({share * 100:.0f}% of gap)" if share else ""))
+            print(f"      {c['detail']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="decompose a jax.profiler Chrome trace into the "
+                    "per-phase / per-category / MFU-gap report")
+    ap.add_argument("trace", help="trace .tar.gz / .json[.gz] / profiler "
+                                  "output directory")
+    ap.add_argument("--json", metavar="OUT", nargs="?", const="-",
+                    default=None,
+                    help="also write the full report as JSON to OUT "
+                         "(bare --json streams to stdout)")
+    ap.add_argument("--layers", type=int, default=None,
+                    help="scan trip count override (default: inferred "
+                         "from the trace; FLOPs math falls back to "
+                         f"{DEFAULTS['layers']})")
+    ap.add_argument("--hidden", type=int, default=DEFAULTS["hidden"])
+    ap.add_argument("--seq", type=int, default=DEFAULTS["seq"])
+    ap.add_argument("--batch", type=int, default=DEFAULTS["batch"])
+    ap.add_argument("--vocab", type=int, default=DEFAULTS["vocab"])
+    ap.add_argument("--params", type=int, default=None,
+                    help="exact parameter count (else approximated from "
+                         "the architecture flags)")
+    ap.add_argument("--flops-per-step", type=float, default=None,
+                    help="override the model-FLOPs estimate entirely")
+    ap.add_argument("--device-kind", default=DEFAULTS["device_kind"],
+                    help="roofline table key (utils/hardware.py); pass '' "
+                         "to skip roofline scoring")
+    ap.add_argument("--top-k", type=int, default=5,
+                    help="gap contributors to name")
+    ap.add_argument("--axis-sizes", default="",
+                    help="mesh degrees for collective attribution, e.g. "
+                         "fsdp=8,tensor=2")
+    args = ap.parse_args(argv)
+
+    flops = args.flops_per_step
+    if flops is None:
+        flops = gpt_flops_per_token(
+            args.layers or DEFAULTS["layers"], args.hidden, args.seq,
+            num_params=args.params,
+            vocab_size=args.vocab) * args.batch * args.seq
+    try:
+        report = perf.analyze(
+            args.trace, flops_per_step=flops,
+            roofline=roofline(args.device_kind) if args.device_kind else None,
+            num_layers=args.layers,
+            axis_sizes=_parse_axis_sizes(args.axis_sizes) or None,
+            top_k=args.top_k)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot analyze {args.trace}: {e}", file=sys.stderr)
+        return 2
+
+    print_report(report)
+    if args.json:
+        payload = json.dumps(report, indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
