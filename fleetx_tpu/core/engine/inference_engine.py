@@ -209,5 +209,7 @@ class InferenceEngine:
                 out = fn(self.params, *arrays)
         else:
             out = self._plain_call(self.params, *arrays)
-        leaves = jax.tree.leaves(out)
-        return [np.asarray(jax.device_get(l)) for l in leaves]
+        # ONE device_get for the whole output tree: per-leaf fetches in a
+        # Python loop serialise the host transfers (and their dispatch
+        # round-trips); a single call batches them
+        return [np.asarray(l) for l in jax.device_get(jax.tree.leaves(out))]

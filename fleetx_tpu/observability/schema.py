@@ -64,18 +64,63 @@ STEP_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
 }
 
 
+# serving-runtime records (docs/serving.md "SLO metrics"): one snapshot
+# per replica flush — ``ServingEngine.serving_snapshot()`` emits exactly
+# this shape and ``tools/serve.py --metrics-out`` appends it as JSONL.
+# TTFT / inter-token quantiles are null until the first request completes
+# (same null-not-zero stance as ``mfu``).
+SERVING_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
+    "ts": (_NUM, True),
+    "scope": ((str,), True),
+    "schema_version": ((int,), False),
+    "requests_admitted": ((int,), True),
+    "requests_completed": ((int,), True),
+    "requests_refused": ((int,), True),
+    "queue_depth": ((int,), True),
+    "active_requests": ((int,), True),
+    "page_occupancy": (_NUM, True),
+    "kv_fragmentation": (_NUM, False),
+    "tokens_total": ((int,), True),
+    "tokens_per_sec": (_NULLABLE_NUM, True),
+    "ttft_p50_s": (_NULLABLE_NUM, True),
+    "ttft_p99_s": (_NULLABLE_NUM, True),
+    "itl_p50_s": (_NULLABLE_NUM, True),
+    "itl_p99_s": (_NULLABLE_NUM, True),
+}
+
+#: registry metric names the serving runtime owns (docs/observability.md):
+#: request-latency histograms + scheduler gauges, all in the PR 1 registry
+SERVING_METRIC_NAMES = (
+    "serving_ttft", "serving_inter_token", "serving_prefill_step",
+    "serving_decode_step", "serving_queue_depth", "serving_active_requests",
+    "serving_page_occupancy", "serving_kv_fragmentation",
+    "serving_requests_total", "serving_requests_completed",
+    "serving_requests_refused", "serving_tokens_total",
+)
+
+
 def record_schema_version(record: dict) -> int:
     """A record's schema version (absent → 1, the pre-gang layout)."""
     v = record.get("schema_version")
     return 1 if v is None else int(v)
 
 
+def validate_serving_record(record: Any) -> list[str]:
+    """Errors for one serving snapshot record; empty list means valid."""
+    return _validate_against(record, SERVING_RECORD_SCHEMA)
+
+
 def validate_record(record: Any) -> list[str]:
-    """Errors for one parsed record; empty list means valid."""
+    """Errors for one parsed step record; empty list means valid."""
+    return _validate_against(record, STEP_RECORD_SCHEMA)
+
+
+def _validate_against(record: Any, schema: dict) -> list[str]:
+    """The shared required/typed/NaN key check behind both validators."""
     if not isinstance(record, dict):
         return [f"record is {type(record).__name__}, expected object"]
     errors = []
-    for key, (types, required) in STEP_RECORD_SCHEMA.items():
+    for key, (types, required) in schema.items():
         if key not in record:
             if required:
                 errors.append(f"missing required key {key!r}")

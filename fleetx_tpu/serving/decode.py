@@ -1,0 +1,252 @@
+"""Jitted paged-attention decode/prefill steps with static shapes.
+
+The serving runtime's device side: two XLA programs, compiled ONCE per
+engine, that the continuous-batching scheduler calls every step —
+
+- ``prefill``: one chunk of ONE request's prompt (``[1, prefill_chunk]``,
+  ragged tail masked) is forwarded, its K/V scattered into the request's
+  pages, and the last valid position's logits/sampled token returned so
+  the final chunk yields the first generated token (TTFT);
+- ``decode``: one token for EVERY slot of the static ``[max_batch]``
+  decode batch — inactive slots point at the null page and are masked, so
+  requests join/leave the batch at step boundaries without changing any
+  shape. Continuous batching therefore **never retraces**
+  (``tests/test_zz_serving.py`` pins the jit cache size at 1).
+
+The forward re-implements the ``models/gpt/model.py`` decode math over the
+RAW parameter pytree (scanned-layer layout) instead of ``model.apply``:
+the dense ``DecodeCache`` threads a single scalar write index through the
+whole batch, which cannot express per-request ragged lengths — the thing
+continuous batching is. Math is kept line-for-line parallel (f32
+layernorms, cfg-dtype matmuls, f32 softmax, gelu ``approximate=True``) so
+greedy decode is token-identical to one-shot ``generation.generate``.
+
+Gather/scatter shape: attention materialises the gathered dense view
+``pool[block_tables] → [B, pages_per_req·page_size, heads, head_dim]``
+inside the jit and lets XLA fuse it; a production TPU build would replace
+that with a Pallas paged-attention kernel that walks block tables in-kernel
+(see ``/opt/skills/guides/pallas_guide.md``), which changes none of the
+host-side machinery here.
+
+Quantized decode (``ServingConfig.quantize_decode``): int8-style fake-quant
+on the decode activations (``Quantization.activation_bits`` →
+``GPTConfig.qat_act_bits`` — wired by PR 2 but consumed by no inference
+path until now) and weights (``qat_bits``), mirroring the training QAT
+placement in ``models/gpt/model.py``; drift is parity-bounded on the CPU
+mesh by ``tests/test_zz_serving.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.gpt import generation as G
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Engine-wide sampling knobs (static: baked into the two programs)."""
+
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+
+
+def _quant(x: jax.Array, bits: int, enabled: bool, axis=None) -> jax.Array:
+    """Config-gated fake-quant (identity when the decode path is fp)."""
+    if not enabled:
+        return x
+    from fleetx_tpu.ops.quantization import fake_quant
+
+    return fake_quant(x, bits, axis=axis)
+
+
+def _layer_norm(p: dict, x: jax.Array, cfg: Any) -> jax.Array:
+    """f32 layernorm matching ``models/gpt/model.py:LayerNorm``."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+    return (y * p["scale"] + p["bias"]).astype(cfg.dtype)
+
+
+def _paged_attention(q: jax.Array, kd: jax.Array, vd: jax.Array,
+                     q_pos: jax.Array) -> jax.Array:
+    """Decode attention over the gathered page view (mirrors
+    ``MultiHeadAttention._decode_attention``).
+
+    ``q`` ``[B, S, heads, hd]``, ``kd``/``vd`` ``[B, K, heads, hd]``
+    (K = pages_per_req · page_size), ``q_pos`` ``[B, S]`` absolute token
+    positions. Every key slot at a position ≤ the query's is a written
+    prefix slot; everything else (unwritten tail, null-page filler) is
+    masked to the dtype's min, which underflows to an exact 0 in the f32
+    softmax — identical math to the dense cache's masked softmax.
+    """
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqnd,bknd->bnqk", q, kd) / \
+        jnp.sqrt(hd).astype(q.dtype)
+    k_pos = jnp.arange(kd.shape[1])
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]          # [B, S, K]
+    scores = jnp.where(mask[:, None], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, vd)
+
+
+def _forward(params: Any, cfg: Any, tokens: jax.Array, positions: jax.Array,
+             pool_k: jax.Array, pool_v: jax.Array, block_tables: jax.Array,
+             quantize: bool) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Forward a ``[B, S]`` token block through the paged decode stack.
+
+    Writes the block's K/V into the pool (scatter by block table), then
+    runs attention per layer against the gathered page view. Returns
+    ``(hidden [B, S, h], pool_k, pool_v)``. ``positions`` are absolute
+    token positions (invalid slots must already be redirected to the null
+    page via ``block_tables``-aware ``positions``/page math by the
+    caller-built scatter indices below).
+    """
+    B, S = tokens.shape
+    ps = pool_k.shape[2]
+    gpt = params["gpt"]
+    emb = gpt["embeddings"]
+
+    wte = emb["word_embeddings"].astype(cfg.dtype)
+    wpe = emb["position_embeddings"].astype(cfg.dtype)
+    safe_pos = jnp.clip(positions, 0, cfg.max_position_embeddings - 1)
+    x = wte[tokens] + wpe[safe_pos]
+
+    # scatter targets, shared by every layer: page id + in-page offset per
+    # (row, slot). Negative positions mark invalid slots → null page 0.
+    page_slot = jnp.clip(positions // ps, 0, block_tables.shape[1] - 1)
+    pages = jnp.take_along_axis(block_tables, page_slot, axis=1)
+    pages = jnp.where(positions >= 0, pages, 0)
+    offs = jnp.clip(positions % ps, 0, ps - 1)
+    q_pos = jnp.maximum(positions, 0)
+
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    act_bits, w_bits = cfg.qat_act_bits, cfg.qat_bits
+
+    def layer(x, scanned):
+        lp, pk_l, pv_l = scanned
+        residual = x
+        y = _layer_norm(lp["ln1"], x, cfg)
+
+        y_in = _quant(y, act_bits, quantize)
+        qkv_k = _quant(lp["attn"]["qkv_kernel"].astype(cfg.dtype), w_bits,
+                       quantize, axis=0)
+        qkv = jnp.einsum("bsh,hcnd->bcsnd", y_in, qkv_k)
+        qkv = qkv + lp["attn"]["qkv_bias"].astype(cfg.dtype)[:, None]
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, S, nh, hd]
+
+        pk_l = pk_l.at[pages, offs].set(k)
+        pv_l = pv_l.at[pages, offs].set(v)
+        kd = pk_l[block_tables].reshape(B, -1, nh, hd)
+        vd = pv_l[block_tables].reshape(B, -1, nh, hd)
+        attn = _paged_attention(q, kd, vd, q_pos)
+
+        attn = _quant(attn, act_bits, quantize)
+        out_k = _quant(lp["attn"]["out_kernel"].astype(cfg.dtype), w_bits,
+                       quantize, axis=(0, 1))
+        y = jnp.einsum("bsnd,ndh->bsh", attn, out_k)
+        y = y + lp["attn"]["out_bias"].astype(cfg.dtype)
+        x = residual + y
+
+        residual = x
+        y = _layer_norm(lp["ln2"], x, cfg)
+        y_in = _quant(y, act_bits, quantize)
+        wi = _quant(lp["mlp"]["wi_kernel"].astype(cfg.dtype), w_bits,
+                    quantize, axis=0)
+        y = jnp.einsum("bsh,hm->bsm", y_in, wi) + \
+            lp["mlp"]["wi_bias"].astype(cfg.dtype)
+        y = jax.nn.gelu(y, approximate=True)
+        y = _quant(y, act_bits, quantize)
+        wo = _quant(lp["mlp"]["wo_kernel"].astype(cfg.dtype), w_bits,
+                    quantize, axis=0)
+        y = jnp.einsum("bsm,mh->bsh", y, wo) + \
+            lp["mlp"]["wo_bias"].astype(cfg.dtype)
+        x = residual + y
+        return x, (pk_l, pv_l)
+
+    x = x.astype(cfg.dtype)
+    x, (pool_k, pool_v) = jax.lax.scan(
+        layer, x, (gpt["layers"], pool_k, pool_v))
+    x = _layer_norm(gpt["ln_f"], x, cfg)
+    return x, pool_k, pool_v
+
+
+def _logits(params: Any, cfg: Any, x_last: jax.Array) -> jax.Array:
+    """Tied-embedding LM head on the selected positions → f32 ``[B, V]``."""
+    wte = params["gpt"]["embeddings"]["word_embeddings"].astype(cfg.dtype)
+    return jnp.einsum("bh,vh->bv", x_last, wte).astype(jnp.float32)
+
+
+def _sample(logits: jax.Array, rng: jax.Array,
+            sp: SamplingParams) -> jax.Array:
+    """Greedy argmax or the sampling-transform chain shared with
+    ``generation.generate`` (temperature → top-k → top-p → categorical)."""
+    if not sp.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    l = G.apply_temperature(logits, sp.temperature)
+    l = G.apply_top_k(l, sp.top_k)
+    l = G.apply_top_p(l, sp.top_p)
+    return jax.random.categorical(rng, l, axis=-1).astype(jnp.int32)
+
+
+def make_step_fns(cfg: Any, *, max_batch: int, pages_per_req: int,
+                  prefill_chunk: int, sampling: SamplingParams,
+                  quantize: bool = False,
+                  pool_sharding: Optional[Any] = None) -> dict:
+    """Build the two jitted serving programs for one engine.
+
+    Returns ``{"prefill": fn, "decode": fn}``; both donate the pool
+    buffers (the engine rebinds them every call) and carry fully static
+    shapes — ``max_batch``/``pages_per_req``/``prefill_chunk`` are baked
+    in, so the jit caches hold exactly one entry each for the engine's
+    lifetime. ``pool_sharding`` (a ``NamedSharding``) keeps the pools
+    constrained to their mesh placement through every step.
+    """
+
+    def constrain(pool):
+        if pool_sharding is None:
+            return pool
+        return jax.lax.with_sharding_constraint(pool, pool_sharding)
+
+    def prefill(params, pool_k, pool_v, tokens, block_table, start, n_valid,
+                rng):
+        """One prompt chunk for one request: ``tokens`` ``[1, C]`` with
+        ``n_valid`` real entries starting at absolute position ``start``;
+        returns the pools plus the last valid position's sampled token and
+        f32 logits (meaningful on the request's final chunk)."""
+        idx = jnp.arange(prefill_chunk)[None, :]
+        positions = jnp.where(idx < n_valid, start + idx, -1)
+        x, pool_k, pool_v = _forward(params, cfg, tokens, positions,
+                                     pool_k, pool_v, block_table, quantize)
+        last = jnp.clip(n_valid - 1, 0, prefill_chunk - 1)
+        x_last = jax.lax.dynamic_index_in_dim(x[0], last, axis=0,
+                                              keepdims=False)[None]
+        logits = _logits(params, cfg, x_last)
+        return (constrain(pool_k), constrain(pool_v),
+                _sample(logits, rng, sampling), logits)
+
+    def decode(params, pool_k, pool_v, tokens, block_tables, lens, rng):
+        """One decode step for the full static batch: ``tokens``/``lens``
+        ``[max_batch]`` (inactive slots carry ``lens < 0`` and null-page
+        block tables); returns pools + sampled tokens + f32 logits."""
+        positions = jnp.where(lens >= 0, lens, -1)[:, None]
+        x, pool_k, pool_v = _forward(params, cfg, tokens[:, None], positions,
+                                     pool_k, pool_v, block_tables, quantize)
+        logits = _logits(params, cfg, x[:, 0])
+        return (constrain(pool_k), constrain(pool_v),
+                _sample(logits, rng, sampling), logits)
+
+    del max_batch, pages_per_req  # shapes arrive via the arrays themselves
+    return {
+        "prefill": jax.jit(prefill, donate_argnums=(1, 2)),
+        "decode": jax.jit(decode, donate_argnums=(1, 2)),
+    }

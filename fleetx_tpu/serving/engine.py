@@ -1,0 +1,404 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+One ``ServingEngine`` owns the device state (params + page pools + the two
+jitted step programs from ``serving/decode.py``) and the host state (slot
+table, block tables, page allocator, request queues). The scheduler runs
+the vLLM-style loop, one ``step()`` per iteration:
+
+1. **admit** — waiting requests take a free decode slot + an up-front page
+   reservation (``ceil((prompt + max_new) / page_size)`` pages); requests
+   the pool could NEVER hold are refused at ``submit`` (OOM admission
+   refusal), requests that merely don't fit *right now* wait;
+2. **prefill** — ONE chunk (``prefill_chunk`` tokens) of the oldest
+   prefilling request is forwarded; long prompts therefore spread over
+   several steps instead of stalling the decode batch, and the final
+   chunk's logits yield the request's first token (TTFT);
+3. **decode** — one token for every RUNNING slot in a single static-shape
+   step; new requests join at the next step boundary, finished ones
+   (eos / ``max_new_tokens``) free their pages and leave — no retrace in
+   either direction.
+
+Telemetry rides the PR 1 metrics registry (``serving_ttft`` /
+``serving_inter_token`` histograms; queue-depth / active-request /
+page-occupancy gauges), serving events land in the PR 8 flight ring, and
+``serving_snapshot()`` emits the record shape
+``observability/schema.py:SERVING_RECORD_SCHEMA`` validates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+# the serving engine is a sharded path (pool over fsdp/tensor), and the
+# mesh substrate pins jax_threefry_partitionable at import — BEFORE any
+# seeded param init, so a replica's init matches the trainer's and every
+# sibling replica's regardless of which modules loaded first
+# (parallel/mesh.py documents the layout-variance this prevents)
+import fleetx_tpu.parallel.mesh  # noqa: F401  (imported for its config pin)
+from fleetx_tpu.observability import flight
+from fleetx_tpu.observability.metrics import get_registry
+from fleetx_tpu.serving.decode import SamplingParams, make_step_fns
+from fleetx_tpu.serving.paged_cache import (NULL_PAGE, PageAllocator,
+                                            init_pool, pool_shardings)
+from fleetx_tpu.utils.log import logger
+
+#: request lifecycle states
+WAITING, PREFILL, RUNNING, FINISHED, REFUSED = (
+    "waiting", "prefill", "running", "finished", "refused")
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """The ``Serving:`` YAML section (docs/serving.md "Sizing the pool")."""
+
+    max_batch: int = 8          # decode slots (static batch dim)
+    page_size: int = 16         # tokens per KV page
+    num_pages: int = 64         # pool pages INCLUDING the reserved null page
+    max_seq_len: int = 0        # 0 → model max_position_embeddings
+    prefill_chunk: int = 32     # prompt tokens forwarded per step
+    quantize_decode: bool = False  # int8-act decode (Quantization bits)
+    # checkpoint directory to restore params from (tools/serve.py feeds it
+    # through the PR 7 integrity-verified loader); None = seeded init
+    ckpt_dir: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ServingConfig":
+        """Build from a YAML ``Serving`` section (unknown keys rejected)."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        d = dict(d or {})
+        unknown = set(d) - known
+        assert not unknown, f"unknown Serving config keys: {sorted(unknown)}"
+        return cls(**{k: v for k, v in d.items() if v is not None})
+
+
+@dataclasses.dataclass
+class ServingRequest:
+    """One in-flight generation request and its bookkeeping."""
+
+    id: str
+    prompt: list
+    max_new_tokens: int
+    callback: Optional[Callable] = None
+    state: str = WAITING
+    slot: int = -1
+    pages: list = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0
+    tokens: list = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    submitted_at: float = 0.0
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Seconds from submission to the first generated token."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class ServingEngine:
+    """Request-level decode runtime (see module docstring for the loop)."""
+
+    def __init__(self, model_cfg: Any, params: Any,
+                 serving: Optional[ServingConfig] = None,
+                 sampling: Optional[SamplingParams] = None,
+                 eos_token_id: int = 50256, mesh: Optional[Any] = None,
+                 seed: int = 0):
+        from flax.core import meta
+
+        self.cfg = model_cfg
+        self.serving = serving or ServingConfig()
+        self.sampling = sampling or SamplingParams()
+        self.eos_token_id = int(eos_token_id)
+        self.mesh = mesh
+        sc = self.serving
+        self.max_seq_len = int(sc.max_seq_len) or model_cfg.max_position_embeddings
+        assert self.max_seq_len <= model_cfg.max_position_embeddings, \
+            "Serving.max_seq_len exceeds the model's position table"
+        self.pages_per_req = -(-self.max_seq_len // sc.page_size)
+
+        self.params = meta.unbox(params)
+        self.allocator = PageAllocator(sc.num_pages, sc.page_size)
+        self.pool_k, self.pool_v = init_pool(model_cfg, sc.num_pages,
+                                             sc.page_size)
+        sharding = None
+        if mesh is not None:
+            sharding = pool_shardings(mesh)
+            self.pool_k = jax.device_put(self.pool_k, sharding)
+            self.pool_v = jax.device_put(self.pool_v, sharding)
+        self._fns = make_step_fns(
+            model_cfg, max_batch=sc.max_batch,
+            pages_per_req=self.pages_per_req,
+            prefill_chunk=sc.prefill_chunk, sampling=self.sampling,
+            quantize=bool(sc.quantize_decode), pool_sharding=sharding)
+
+        # host-side scheduler state
+        self._slots: list = [None] * sc.max_batch
+        self._block_tables = np.full((sc.max_batch, self.pages_per_req),
+                                     NULL_PAGE, np.int32)
+        self._lens = np.full((sc.max_batch,), -1, np.int32)
+        self._last_tokens = np.zeros((sc.max_batch,), np.int32)
+        self._waiting: deque = deque()
+        self._prefilling: deque = deque()
+        self._rng = jax.random.PRNGKey(int(seed))
+        self.draining = False
+        self.steps = 0
+        self._started_at = time.monotonic()
+        self.metrics = get_registry()
+        logger.info(
+            "serving engine: max_batch=%d pages=%d x %d tokens "
+            "(capacity %d token slots/layer), prefill_chunk=%d, "
+            "quantize_decode=%s", sc.max_batch, self.allocator.usable_pages,
+            sc.page_size, self.allocator.usable_pages * sc.page_size,
+            sc.prefill_chunk, bool(sc.quantize_decode))
+
+    # ------------------------------------------------------------ submission
+    def submit(self, prompt: list, max_new_tokens: int,
+               request_id: Optional[str] = None,
+               callback: Optional[Callable] = None) -> ServingRequest:
+        """Queue one request; refusals (drain / permanent OOM) come back
+        with ``state == REFUSED`` and ``error`` set, never queued."""
+        rid = request_id or f"req{self.metrics.counter('serving_requests_total').value:.0f}"
+        req = ServingRequest(id=str(rid), prompt=[int(t) for t in prompt],
+                             max_new_tokens=int(max_new_tokens),
+                             callback=callback, submitted_at=time.monotonic())
+        self.metrics.counter("serving_requests_total").inc()
+        need_tokens = len(req.prompt) + req.max_new_tokens
+        need_pages = self.allocator.pages_needed(need_tokens)
+        if self.draining:
+            return self._refuse(req, "draining")
+        if not req.prompt or need_tokens > self.max_seq_len or \
+                not self.allocator.fits_ever(need_pages):
+            return self._refuse(
+                req, f"oom: request needs {need_pages} pages / "
+                     f"{need_tokens} tokens; pool holds "
+                     f"{self.allocator.usable_pages} pages of "
+                     f"{self.allocator.page_size}")
+        self._waiting.append(req)
+        flight.note("serving", "submit", id=req.id,
+                    prompt_len=len(req.prompt))
+        return req
+
+    def _refuse(self, req: ServingRequest, why: str) -> ServingRequest:
+        req.state, req.error = REFUSED, why
+        req.finished_at = time.monotonic()
+        self.metrics.counter("serving_requests_refused").inc()
+        flight.note("serving", "refuse", id=req.id, why=why)
+        if req.callback:
+            req.callback(req)
+        return req
+
+    # -------------------------------------------------------------- schedule
+    def _admit(self) -> None:
+        """Waiting → prefill while a slot AND a full page reservation fit
+        (strict FIFO: head-of-line blocking keeps admission fair)."""
+        while self._waiting:
+            req = self._waiting[0]
+            try:
+                slot = self._slots.index(None)
+            except ValueError:
+                return
+            need = self.allocator.pages_needed(
+                len(req.prompt) + req.max_new_tokens)
+            pages = self.allocator.alloc(need)
+            if pages is None:
+                return
+            self._waiting.popleft()
+            req.state, req.slot, req.pages = PREFILL, slot, pages
+            self._slots[slot] = req
+            self._block_tables[slot] = NULL_PAGE
+            self._block_tables[slot, :need] = pages
+            self._lens[slot] = -1  # joins the decode batch after prefill
+            self._prefilling.append(req)
+            flight.note("serving", "admit", id=req.id, slot=slot,
+                        pages=need)
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _prefill_step(self) -> bool:
+        """Forward one chunk of the oldest prefilling request."""
+        if not self._prefilling:
+            return False
+        req = self._prefilling[0]
+        sc = self.serving
+        pos = req.prefill_pos
+        chunk = req.prompt[pos:pos + sc.prefill_chunk]
+        n_valid = len(chunk)
+        tokens = np.zeros((1, sc.prefill_chunk), np.int32)
+        tokens[0, :n_valid] = chunk
+        table = self._block_tables[req.slot:req.slot + 1]
+        with self.metrics.timer("serving_prefill_step"):
+            self.pool_k, self.pool_v, tok, _ = self._fns["prefill"](
+                self.params, self.pool_k, self.pool_v, tokens, table,
+                np.int32(pos), np.int32(n_valid), self._next_rng())
+            req.prefill_pos = pos + n_valid
+            if req.prefill_pos >= len(req.prompt):
+                first = int(jax.device_get(tok)[0])
+                self._prefilling.popleft()
+                now = time.monotonic()
+                req.first_token_at = req.last_token_at = now
+                self.metrics.histogram("serving_ttft").record(req.ttft_s)
+                self._emit(req, first)
+                if req.state != FINISHED:
+                    req.state = RUNNING
+                    self._lens[req.slot] = len(req.prompt)
+                    self._last_tokens[req.slot] = first
+                flight.note("serving", "first_token", id=req.id)
+        return True
+
+    def _decode_step(self) -> bool:
+        """One token for every RUNNING slot (static batch; masked rows)."""
+        running = [r for r in self._slots
+                   if r is not None and r.state == RUNNING]
+        if not running:
+            return False
+        with self.metrics.timer("serving_decode_step"):
+            self.pool_k, self.pool_v, toks, _ = self._fns["decode"](
+                self.params, self.pool_k, self.pool_v, self._last_tokens,
+                self._block_tables, self._lens, self._next_rng())
+            toks = jax.device_get(toks)
+            now = time.monotonic()
+            for req in running:
+                tok = int(toks[req.slot])
+                self._lens[req.slot] += 1  # the step wrote position `lens`
+                self.metrics.histogram("serving_inter_token").record(
+                    now - req.last_token_at)
+                req.last_token_at = now
+                self._emit(req, tok)
+                if req.state != FINISHED:
+                    self._last_tokens[req.slot] = tok
+        return True
+
+    def _emit(self, req: ServingRequest, token: int) -> None:
+        """Record one generated token and finish on eos / length."""
+        req.tokens.append(token)
+        self.metrics.counter("serving_tokens_total").inc()
+        if token == self.eos_token_id or \
+                len(req.tokens) >= req.max_new_tokens:
+            self._finish(req)
+
+    def _finish(self, req: ServingRequest) -> None:
+        req.state = FINISHED
+        req.finished_at = time.monotonic()
+        self.allocator.free(req.pages)
+        slot = req.slot
+        self._slots[slot] = None
+        self._block_tables[slot] = NULL_PAGE
+        self._lens[slot] = -1
+        self._last_tokens[slot] = 0
+        self.metrics.counter("serving_requests_completed").inc()
+        flight.note("serving", "finish", id=req.id,
+                    new_tokens=len(req.tokens))
+        if req.callback:
+            req.callback(req)
+
+    # ------------------------------------------------------------------ loop
+    def step(self) -> bool:
+        """One scheduler iteration; True when any device work ran."""
+        self._admit()
+        worked = self._prefill_step()
+        worked = self._decode_step() or worked
+        if worked:
+            self.steps += 1
+        self._update_gauges()
+        return worked
+
+    def has_work(self) -> bool:
+        """Anything queued, prefilling or decoding?"""
+        return bool(self._waiting or self._prefilling
+                    or any(r is not None for r in self._slots))
+
+    def run_until_drained(self, max_steps: int = 100_000) -> None:
+        """Step until every queued request has finished (tests/bench)."""
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            assert steps < max_steps, "serving loop failed to drain"
+
+    def begin_drain(self) -> None:
+        """Stop admitting NEW submissions; everything already queued or in
+        flight runs to completion (the graceful-preemption contract)."""
+        if not self.draining:
+            self.draining = True
+            flight.note("serving", "drain",
+                        active=sum(r is not None for r in self._slots),
+                        queued=len(self._waiting))
+            logger.warning("serving engine draining: finishing %d in-flight "
+                           "request(s)", sum(r is not None
+                                             for r in self._slots)
+                           + len(self._waiting))
+
+    # ------------------------------------------------------------- telemetry
+    def reset_stats(self) -> None:
+        """Zero the serving counters/histograms and restart the throughput
+        clock — the bench calls this after its warmup request so compile
+        time never pollutes tokens/s or the latency quantiles."""
+        for name in ("serving_requests_total", "serving_requests_completed",
+                     "serving_requests_refused", "serving_tokens_total"):
+            self.metrics.counter(name).reset()
+        for name in ("serving_ttft", "serving_inter_token",
+                     "serving_prefill_step", "serving_decode_step"):
+            h = self.metrics.histogram(name)
+            h.reset()
+            h.total_count = 0
+            h.total_sum = 0.0
+        self._started_at = time.monotonic()
+
+    def _used_slots(self) -> int:
+        """Token positions actually written across live requests."""
+        used = int(self._lens[self._lens >= 0].sum())
+        used += sum(r.prefill_pos for r in self._prefilling)
+        return used
+
+    def _update_gauges(self) -> None:
+        self.metrics.gauge("serving_queue_depth").set(len(self._waiting))
+        self.metrics.gauge("serving_active_requests").set(
+            sum(r is not None for r in self._slots))
+        self.metrics.gauge("serving_page_occupancy").set(
+            self.allocator.occupancy())
+        self.metrics.gauge("serving_kv_fragmentation").set(
+            self.allocator.internal_fragmentation(self._used_slots()))
+
+    def serving_snapshot(self) -> dict:
+        """One JSON-ready record in the ``SERVING_RECORD_SCHEMA`` shape."""
+        m = self.metrics
+        wall = max(time.monotonic() - self._started_at, 1e-9)
+        ttft = m.histogram("serving_ttft").summary()
+        itl = m.histogram("serving_inter_token").summary()
+        tokens = m.counter("serving_tokens_total").value
+        return {
+            "ts": time.time(),
+            "scope": "serving",
+            "schema_version": 2,
+            "requests_admitted": int(
+                m.counter("serving_requests_total").value
+                - m.counter("serving_requests_refused").value),
+            "requests_completed": int(
+                m.counter("serving_requests_completed").value),
+            "requests_refused": int(
+                m.counter("serving_requests_refused").value),
+            "queue_depth": int(m.gauge("serving_queue_depth").value or 0),
+            "active_requests": int(
+                m.gauge("serving_active_requests").value or 0),
+            "page_occupancy": float(
+                m.gauge("serving_page_occupancy").value or 0.0),
+            "kv_fragmentation": float(
+                m.gauge("serving_kv_fragmentation").value or 0.0),
+            "tokens_total": int(tokens),
+            "tokens_per_sec": tokens / wall,
+            "ttft_p50_s": ttft.get("p50"),
+            "ttft_p99_s": ttft.get("p99"),
+            "itl_p50_s": itl.get("p50"),
+            "itl_p99_s": itl.get("p99"),
+        }

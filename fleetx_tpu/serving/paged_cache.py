@@ -1,0 +1,143 @@
+"""Paged KV cache: a preallocated page pool + a host-side page allocator.
+
+The training-era ``DecodeCache`` (``models/gpt/model.py``) is one dense
+``[layers, batch, max_len, heads, head_dim]`` buffer per generate() call:
+every row pays ``max_len`` slots whether its request is 4 tokens or 4000,
+and the buffer's batch dim is welded to one call's lifetime. Serving needs
+the vLLM-style shape instead: ONE pool of fixed-size pages allocated for
+the process lifetime, per-request *block tables* mapping logical token
+positions to pool pages, and a host-side allocator that admits or refuses
+requests against real free capacity ("Compiler-First State Space Duality
+and Portable O(1) Autoregressive Caching for Inference", PAPERS.md, is the
+O(1)-append blueprint this follows).
+
+Pool layout (K and V each)::
+
+    [layers, num_pages, page_size, heads, head_dim]
+
+Page 0 is the reserved **null page**: block-table filler slots and masked
+(inactive) batch rows point at it, so the jitted steps can scatter/gather
+with fully static shapes and no host-side branching — garbage written to
+or read from page 0 is always masked out of the attention scores.
+
+Sharding: ``pool_shardings`` places the page dim over ``fsdp`` and the
+heads dim over ``tensor``, so cache capacity scales with the mesh the same
+way the reference's dp-sharded serving scaled batch
+(``inference_engine.py:128-163``); the engine keeps the pool constrained
+through every jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: reserved scratch page — never allocated, always masked when read
+NULL_PAGE = 0
+
+
+def init_pool(cfg: Any, num_pages: int, page_size: int,
+              dtype: Any = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Allocate the (K, V) page pools for a GPT config.
+
+    ``num_pages`` INCLUDES the reserved null page, so usable capacity is
+    ``(num_pages - 1) * page_size`` token slots per layer.
+    """
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, int(num_pages), int(page_size),
+             cfg.num_attention_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def pool_shardings(mesh: Mesh) -> NamedSharding:
+    """The pool's mesh placement: pages over ``fsdp``, heads over ``tensor``.
+
+    Pool dims are ``(layers, pages, page_size, heads, head_dim)`` — the
+    page dim shards over the ZeRO axis (capacity scales with fsdp degree)
+    and the heads dim over the Megatron axis (matching the dense decode
+    cache's ``act_heads → tensor`` rule in ``parallel/sharding.py``).
+    """
+    return NamedSharding(mesh, P(None, "fsdp", None, "tensor", None))
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the pool's page ids.
+
+    Admission policy is **reserve-up-front**: the engine allocates every
+    page a request could ever need (``ceil((prompt + max_new) / page_size)``)
+    at admission, so a running request can never hit a mid-decode OOM and
+    no preemption/swap machinery is needed. The cost is internal
+    fragmentation (tail-page slots reserved but not yet written), which
+    ``internal_fragmentation`` reports so the occupancy gauge stays honest.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        assert num_pages >= 2, "need at least the null page + one usable page"
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list → recently-freed (cache-warm) pages are reused first
+        self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
+        self._allocated: set[int] = set()
+
+    # ------------------------------------------------------------- capacity
+    @property
+    def usable_pages(self) -> int:
+        """Pages that can ever be handed out (pool minus the null page)."""
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_pages(self) -> int:
+        return len(self._allocated)
+
+    def pages_needed(self, tokens: int) -> int:
+        """Pages required to hold ``tokens`` KV entries."""
+        return max(-(-int(tokens) // self.page_size), 1)
+
+    def can_allocate(self, n: int) -> bool:
+        """Whether ``n`` pages are free right now."""
+        return n <= len(self._free)
+
+    def fits_ever(self, n: int) -> bool:
+        """Whether ``n`` pages could EVER be satisfied — False is the
+        permanent-refusal signal (the request is larger than the pool)."""
+        return n <= self.usable_pages
+
+    # ------------------------------------------------------------ alloc/free
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """Allocate ``n`` pages, or None (leaving state untouched) when the
+        free list cannot satisfy the request — never a partial grant."""
+        if n <= 0 or n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        """Return ``pages`` to the free list (double-free is an error)."""
+        for p in pages:
+            assert p in self._allocated, f"freeing unallocated page {p}"
+            self._allocated.discard(p)
+            self._free.append(p)
+
+    # ------------------------------------------------------------- metrics
+    def occupancy(self) -> float:
+        """Allocated fraction of usable pages (the page-occupancy gauge)."""
+        return len(self._allocated) / max(self.usable_pages, 1)
+
+    def internal_fragmentation(self, used_slots: int) -> float:
+        """Reserved-but-unwritten fraction of the allocated slots.
+
+        ``used_slots`` is the engine's count of token positions actually
+        written across live requests; everything else inside allocated
+        pages is reservation overhead of the admission policy.
+        """
+        allocated_slots = len(self._allocated) * self.page_size
+        if allocated_slots <= 0:
+            return 0.0
+        return 1.0 - min(int(used_slots), allocated_slots) / allocated_slots

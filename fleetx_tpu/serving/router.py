@@ -1,0 +1,226 @@
+"""Request router over N serving replicas — stdlib-only, jax-free.
+
+Sits in front of the supervised replica fleet (one ``tools/supervise.py``
+per replica, docs/serving.md "Fleet layout") and owns the loss-free
+re-dispatch contract: a request the router has ACCEPTED is retried against
+surviving replicas until some replica completes it — replica crashes
+(connection reset, supervisor restarting the process) and graceful drains
+(the explicit ``"draining"`` response) both just mark the backend penalised
+for a cooldown and move the request on. Decode requests are pure functions
+of (params, prompt), so re-dispatch is idempotent by construction.
+
+Placement policy: **least-outstanding** with round-robin tie-break — the
+cheapest estimator of per-replica queue depth that needs no backend
+cooperation (each replica already exports its own queue gauges).
+
+This module deliberately imports no jax so ``python -m
+fleetx_tpu.serving.router`` starts in milliseconds — the router must come
+up before (and outlive) the replicas it fronts.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Optional
+
+#: seconds a failed/draining backend is skipped before being retried
+#: (a supervisor restart needs a few seconds to bring the replica back)
+PENALTY_S = 1.0
+
+#: total seconds the router keeps retrying one accepted request before
+#: answering "no backend" — covers a full supervisor restart cycle
+DISPATCH_DEADLINE_S = 120.0
+
+
+def _read_line(conn: socket.socket) -> bytes:
+    """Read one newline-terminated frame (the shared half of the wire
+    protocol — ``serving/server.py`` documents it; this copy keeps the
+    router importable without the jax-adjacent server module)."""
+    buf = b""
+    while not buf.endswith(b"\n"):
+        chunk = conn.recv(4096)
+        if not chunk:
+            break  # EOF mid-frame — caller decides if that is an error
+        buf += chunk
+    return buf
+
+
+class Backend:
+    """One replica address + its health/placement bookkeeping."""
+
+    def __init__(self, host: str, port: int):
+        self.addr = (host, int(port))
+        self.outstanding = 0
+        self.penalized_until = 0.0
+        self.dispatched = 0
+        self.failures = 0
+
+    def available(self, now: float) -> bool:
+        """Whether placement may pick this backend right now."""
+        return now >= self.penalized_until
+
+    def penalize(self, now: float, seconds: float = PENALTY_S) -> None:
+        """Skip this backend for ``seconds`` (crash or drain observed)."""
+        self.penalized_until = now + seconds
+        self.failures += 1
+
+
+class Router:
+    """Round-robin + least-outstanding front over the replica fleet."""
+
+    def __init__(self, backends: list, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout: float = 120.0):
+        self.backends = [Backend(h, p) for h, p in backends]
+        assert self.backends, "router needs at least one backend"
+        self.host = host
+        self.port = int(port)
+        self.request_timeout = float(request_timeout)
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self.retries = 0
+
+    # ------------------------------------------------------------ placement
+    def pick(self) -> Optional[Backend]:
+        """Least outstanding among available backends, round-robin ties;
+        None when every backend is inside its penalty window."""
+        now = time.monotonic()
+        with self._lock:
+            avail = [b for b in self.backends if b.available(now)]
+            if not avail:
+                return None
+            best = min(b.outstanding for b in avail)
+            tied = [b for b in avail if b.outstanding == best]
+            choice = tied[self._rr % len(tied)]
+            self._rr += 1
+            choice.outstanding += 1
+            choice.dispatched += 1
+            return choice
+
+    def _release(self, backend: Backend) -> None:
+        with self._lock:
+            backend.outstanding = max(backend.outstanding - 1, 0)
+
+    # ------------------------------------------------------------- dispatch
+    def dispatch(self, payload: dict) -> dict:
+        """Forward one request, re-dispatching across backends until a
+        replica completes it or the deadline passes."""
+        deadline = time.monotonic() + DISPATCH_DEADLINE_S
+        while time.monotonic() < deadline:
+            backend = self.pick()
+            if backend is None:
+                time.sleep(0.05)  # whole fleet penalised — restart window
+                continue
+            try:
+                resp = self._forward(backend, payload)
+            except (OSError, ValueError):
+                # transport failure OR a torn/garbled response line (a
+                # replica killed mid-write) — both mean "this backend did
+                # not complete the request": penalise and re-dispatch
+                backend.penalize(time.monotonic())
+                self.retries += 1
+                continue
+            finally:
+                self._release(backend)
+            if resp.get("error") == "draining":
+                # graceful reclaim: stop placing onto this backend and
+                # retry the request elsewhere, losing nothing
+                backend.penalize(time.monotonic())
+                self.retries += 1
+                continue
+            return resp
+        return {"id": payload.get("id"), "error": "no backend available"}
+
+    def _forward(self, backend: Backend, payload: dict) -> dict:
+        with socket.create_connection(backend.addr,
+                                      timeout=self.request_timeout) as conn:
+            conn.sendall((json.dumps(payload) + "\n").encode("utf-8"))
+            conn.settimeout(self.request_timeout)
+            buf = _read_line(conn)
+        if not buf.strip():
+            raise ConnectionError(f"empty response from {backend.addr}")
+        # a torn line (replica died mid-write) raises ValueError → retry
+        return json.loads(buf.decode("utf-8"))
+
+    # -------------------------------------------------------------- serving
+    def start(self) -> int:
+        """Bind the front socket + accept thread; returns the bound port."""
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="router-accept").start()
+        return self.port
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(self.request_timeout)
+            buf = _read_line(conn)
+            if not buf.strip():
+                return
+            payload = json.loads(buf.decode("utf-8"))
+            resp = self.dispatch(payload)
+            conn.sendall((json.dumps(resp) + "\n").encode("utf-8"))
+        except (OSError, ValueError):
+            pass  # client went away / bad JSON — nothing to answer
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Tear down the front listener."""
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+def main(argv=None) -> int:
+    """``python -m fleetx_tpu.serving.router --port P --backends h:p,h:p``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="fleetx serving router")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--backends", required=True,
+                    help="comma-separated host:port replica list")
+    args = ap.parse_args(argv)
+    backends = []
+    for spec in args.backends.split(","):
+        h, _, p = spec.strip().rpartition(":")
+        backends.append((h or "127.0.0.1", int(p)))
+    router = Router(backends, host=args.host, port=args.port)
+    port = router.start()
+    print(f"[router] listening on {args.host}:{port} over "
+          f"{len(backends)} backend(s)", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

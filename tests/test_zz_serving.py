@@ -1,0 +1,627 @@
+"""Serving runtime: paged KV cache, continuous batching, drain, fleet.
+
+In-process tests pin the scheduler/allocator semantics and the decode
+parity contract (paged continuous-batching decode must be token-identical
+to one-shot ``generation.generate``); subprocess tests drive the REAL
+fleet machinery — a replica draining on an injected SIGTERM
+(``faults.py sigterm_at``) and the 2-replica supervised acceptance drill
+(kill one replica mid-stream; the router must complete every admitted
+request with token-correct output).
+
+Named ``test_zz_*`` so it collects last (same stance as the other zz
+suites): subprocess drills must add coverage after the seed dots, not
+displace them inside the tier-1 timeout window.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.gpt import generation as G
+from fleetx_tpu.models.gpt.model import (GPTConfig, GPTForPretraining,
+                                         config_from_dict)
+from fleetx_tpu.observability.schema import (SERVING_METRIC_NAMES,
+                                             validate_serving_record)
+from fleetx_tpu.serving import (NULL_PAGE, PageAllocator, ServingConfig,
+                                ServingEngine)
+from fleetx_tpu.serving.decode import SamplingParams
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE = os.path.join(REPO, "tools", "serve.py")
+SUPERVISE = os.path.join(REPO, "tools", "supervise.py")
+
+MODEL_DICT = dict(vocab_size=97, hidden_size=64, num_layers=2,
+                  num_attention_heads=4, max_position_embeddings=64,
+                  hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                  use_flash_attention=False, dtype="float32",
+                  param_dtype="float32")
+EOS = 96
+
+
+def _loopback_available() -> bool:
+    """Subprocess socket drills need a bindable loopback (sandbox gate)."""
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+    except OSError:
+        return False
+    return True
+
+
+needs_net = pytest.mark.skipif(not _loopback_available(),
+                               reason="loopback networking unavailable")
+
+
+# ---------------------------------------------------------------------------
+# page allocator units
+# ---------------------------------------------------------------------------
+
+class TestPageAllocator:
+    """Host-side free-list semantics the admission policy stands on."""
+
+    def test_alloc_free_roundtrip_never_hands_out_null_page(self):
+        a = PageAllocator(num_pages=5, page_size=4)
+        assert a.usable_pages == 4 and a.free_pages == 4
+        pages = a.alloc(4)
+        assert pages is not None and len(set(pages)) == 4
+        assert NULL_PAGE not in pages
+        assert a.free_pages == 0 and a.occupancy() == 1.0
+        a.free(pages)
+        assert a.free_pages == 4 and a.allocated_pages == 0
+        assert a.occupancy() == 0.0
+
+    def test_oom_alloc_is_all_or_nothing(self):
+        a = PageAllocator(num_pages=4, page_size=4)
+        assert a.alloc(4) is None  # only 3 usable — no partial grant
+        assert a.free_pages == 3
+        first = a.alloc(2)
+        assert a.alloc(2) is None and a.free_pages == 1
+        a.free(first)
+        assert a.alloc(3) is not None
+
+    def test_fits_ever_vs_can_allocate(self):
+        a = PageAllocator(num_pages=4, page_size=4)
+        held = a.alloc(2)
+        # could fit once pages free → wait; larger than the pool → refuse
+        assert a.fits_ever(3) and not a.can_allocate(3)
+        assert not a.fits_ever(4)
+        a.free(held)
+        assert a.can_allocate(3)
+
+    def test_pages_needed_and_fragmentation(self):
+        a = PageAllocator(num_pages=9, page_size=4)
+        assert a.pages_needed(1) == 1 and a.pages_needed(4) == 1
+        assert a.pages_needed(5) == 2 and a.pages_needed(0) == 1
+        a.alloc(2)  # 8 slots reserved
+        assert a.internal_fragmentation(used_slots=6) == pytest.approx(0.25)
+        assert a.internal_fragmentation(used_slots=8) == 0.0
+        assert a.internal_fragmentation(used_slots=0) == 1.0
+
+    def test_free_list_reuses_freed_pages(self):
+        a = PageAllocator(num_pages=4, page_size=4)
+        pages = a.alloc(3)
+        a.free(pages)
+        again = a.alloc(3)
+        assert sorted(again) == sorted(pages)
+
+
+# ---------------------------------------------------------------------------
+# decode parity (the serving acceptance contract)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def small_model():
+    """The tiny f32 GPT shared by every parity test (same recipe as
+    tests/test_generation.py)."""
+    from flax.core import meta
+
+    cfg = config_from_dict(MODEL_DICT)
+    model = GPTForPretraining(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 8), jnp.int32), None,
+                        deterministic=True)["params"]
+    return cfg, model, meta.unbox(params)
+
+
+def one_shot(model, params, prompts, max_new):
+    """Reference decode: one-shot batched greedy generation."""
+    gen_cfg = G.GenerationConfig(max_new_tokens=max_new, do_sample=False,
+                                 eos_token_id=EOS, pad_token_id=0)
+    tokens, mask = G.left_pad(prompts, 0)
+    return np.asarray(G.generate(model, params, gen_cfg,
+                                 jnp.asarray(tokens), jnp.asarray(mask),
+                                 jax.random.PRNGKey(1)))
+
+
+def check_parity(req, want_row):
+    """Serving tokens must equal the one-shot row (eos-trimmed)."""
+    got = req.tokens
+    want = [int(t) for t in want_row]
+    assert got == want[:len(got)], (req.id, got, want)
+    assert len(got) == len(want) or got[-1] == EOS, (req.id, got, want)
+
+
+@pytest.fixture()
+def engine(small_model):
+    cfg, _, params = small_model
+    eng = ServingEngine(
+        cfg, params,
+        ServingConfig(max_batch=4, page_size=4, num_pages=33,
+                      max_seq_len=32, prefill_chunk=4),
+        eos_token_id=EOS)
+    # the metrics registry is process-global (one engine per process in
+    # production); tests share it, so zero the serving stats per engine
+    eng.reset_stats()
+    return eng
+
+
+def test_continuous_batching_matches_one_shot(small_model, engine):
+    """Ragged prompts (one longer than the prefill chunk → chunked
+    prefill) decoded through the paged runtime are token-identical to
+    one-shot batch generation."""
+    cfg, model, params = small_model
+    prompts = [[5, 9, 23, 41], [7, 3],
+               [11, 2, 8, 4, 19, 33, 7, 6, 1, 2, 3]]  # 11 > chunk of 4
+    want = one_shot(model, params, prompts, 6)
+    reqs = [engine.submit(p, 6, request_id=f"r{i}")
+            for i, p in enumerate(prompts)]
+    engine.run_until_drained()
+    for req, row in zip(reqs, want):
+        assert req.state == "finished" and req.error is None
+        check_parity(req, row)
+    assert engine.allocator.allocated_pages == 0  # everything freed
+
+
+def test_join_mid_stream_and_never_retraces(small_model, engine):
+    """A request joining while another decodes must not perturb the
+    in-flight stream, and the join must not recompile either program."""
+    cfg, model, params = small_model
+    want = one_shot(model, params, [[5, 9, 23, 41]], 8)
+    want_b = one_shot(model, params, [[7, 3, 11]], 8)
+    a = engine.submit([5, 9, 23, 41], 8, request_id="a")
+    for _ in range(4):  # prefill + a few decode steps
+        engine.step()
+    assert a.state == "running" and len(a.tokens) >= 1
+    b = engine.submit([7, 3, 11], 8, request_id="b")  # joins mid-stream
+    engine.run_until_drained()
+    check_parity(a, want[0])
+    check_parity(b, want_b[0])
+    # static shapes: one compile per program for the engine's lifetime
+    assert engine._fns["decode"]._cache_size() == 1
+    assert engine._fns["prefill"]._cache_size() == 1
+
+
+def test_admission_oom_refusal_queueing_and_drain(small_model):
+    """Permanently-oversized requests refuse at submit; requests that
+    merely don't fit NOW wait for pages; drain refuses new work but
+    finishes everything admitted."""
+    cfg, _, params = small_model
+    eng = ServingEngine(
+        cfg, params,
+        ServingConfig(max_batch=4, page_size=4, num_pages=4,  # 3 usable
+                      max_seq_len=16, prefill_chunk=4),
+        eos_token_id=EOS)
+    eng.reset_stats()
+    # 17 tokens > max_seq_len 16 → permanent refusal, never queued
+    r_oom = eng.submit([1] * 9, 8, request_id="oom")
+    assert r_oom.state == "refused" and "oom" in r_oom.error
+    # 16 tokens fit max_seq_len but need 4 pages > 3 usable → refusal too
+    r_oom2 = eng.submit([1] * 8, 8, request_id="oom2")
+    assert r_oom2.state == "refused" and "oom" in r_oom2.error
+
+    r1 = eng.submit([5, 9, 23, 41], 8, request_id="r1")   # 3 pages
+    r2 = eng.submit([7, 3], 8, request_id="r2")           # 3 pages → waits
+    eng.step()
+    assert r1.state in ("prefill", "running")
+    assert r2.state == "waiting"  # only 1 page free — r2 must wait
+    assert eng.metrics.gauge("serving_queue_depth").value == 1
+
+    eng.begin_drain()
+    r3 = eng.submit([1, 2], 2, request_id="late")
+    assert r3.state == "refused" and r3.error == "draining"
+    eng.run_until_drained()
+    assert r1.state == "finished" and r2.state == "finished"
+    assert eng.metrics.counter("serving_requests_completed").value == 2
+    assert eng.metrics.counter("serving_requests_refused").value == 3
+
+
+def test_quantized_decode_parity_bounded(small_model):
+    """The int8-activation decode path (Quantization.qat_act_bits) stays
+    within a bounded drift of the fp path — same stance as the PR 3 remat
+    drift tests — and still decodes mostly the same greedy tokens on the
+    tiny model."""
+    cfg, _, params = small_model
+    qcfg = config_from_dict(dict(MODEL_DICT, qat_act_bits=8))
+    prompts = [[5, 9, 23, 41], [7, 3, 11]]
+
+    def run(quantize):
+        eng = ServingEngine(
+            qcfg, params,
+            ServingConfig(max_batch=2, page_size=4, num_pages=17,
+                          max_seq_len=32, prefill_chunk=8,
+                          quantize_decode=quantize),
+            eos_token_id=EOS)
+        reqs = [eng.submit(p, 6, request_id=f"q{i}")
+                for i, p in enumerate(prompts)]
+        eng.run_until_drained()
+        # drift probe: the first-step logits of prompt 0, via the raw
+        # prefill program (deterministic, same pages each run)
+        pool_k, pool_v = eng.pool_k, eng.pool_v
+        table = np.zeros((1, eng.pages_per_req), np.int32)
+        table[0, :2] = [1, 2]
+        tokens = np.zeros((1, 8), np.int32)
+        tokens[0, :4] = prompts[0]
+        _, _, _, logits = eng._fns["prefill"](
+            eng.params, pool_k, pool_v, tokens, table, np.int32(0),
+            np.int32(4), jax.random.PRNGKey(0))
+        return [r.tokens for r in reqs], np.asarray(logits)[0]
+
+    fp_tokens, fp_logits = run(False)
+    q_tokens, q_logits = run(True)
+    drift = np.abs(q_logits - fp_logits).max() / \
+        max(np.abs(fp_logits).max(), 1e-9)
+    assert drift < 0.05, f"int8-act decode drifted {drift:.4f} from fp"
+    # token streams may diverge after a near-tie, but not wholesale
+    agree = sum(a == b for a, b in zip(fp_tokens[0], q_tokens[0]))
+    assert agree >= len(fp_tokens[0]) // 2, (fp_tokens, q_tokens)
+
+
+def test_pool_sharded_over_mesh_keeps_parity(small_model, devices8):
+    """Pages shard over fsdp, heads over tensor: capacity scales with the
+    mesh and greedy decode stays token-identical."""
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    cfg, model, params = small_model
+    mesh = build_mesh({"fsdp_degree": 2, "mp_degree": 2})
+    eng = ServingEngine(
+        cfg, params,
+        ServingConfig(max_batch=2, page_size=4, num_pages=32,
+                      max_seq_len=32, prefill_chunk=4),
+        eos_token_id=EOS, mesh=mesh)
+    def norm(spec):
+        # PartitionSpec canonicalisation may drop trailing Nones
+        return (tuple(spec) + (None,) * 5)[:5]
+
+    assert norm(eng.pool_k.sharding.spec) == \
+        (None, "fsdp", None, "tensor", None)
+    want = one_shot(model, params, [[5, 9, 23, 41], [7, 3]], 6)
+    reqs = [eng.submit(p, 6, request_id=f"m{i}")
+            for i, p in enumerate([[5, 9, 23, 41], [7, 3]])]
+    eng.run_until_drained()
+    for req, row in zip(reqs, want):
+        check_parity(req, row)
+    # the pool stays sharded through the donated-buffer step updates
+    assert norm(eng.pool_k.sharding.spec) == \
+        (None, "fsdp", None, "tensor", None)
+
+
+# ---------------------------------------------------------------------------
+# telemetry schema + perf gate wiring
+# ---------------------------------------------------------------------------
+
+def test_serving_snapshot_validates_and_metrics_registered(small_model,
+                                                           engine):
+    cfg, model, params = small_model
+    req = engine.submit([5, 9, 23], 4, request_id="t")
+    engine.run_until_drained()
+    snap = engine.serving_snapshot()
+    assert validate_serving_record(snap) == []
+    assert snap["requests_completed"] == 1 and snap["tokens_total"] >= 1
+    assert snap["ttft_p50_s"] is not None and snap["itl_p50_s"] is not None
+    for name in ("serving_ttft", "serving_inter_token",
+                 "serving_queue_depth"):
+        assert name in SERVING_METRIC_NAMES
+    # negative: a NaN quantile or missing required key must not validate
+    bad = dict(snap, tokens_per_sec=float("nan"))
+    assert validate_serving_record(bad)
+    del bad["tokens_per_sec"]
+    assert any("tokens_per_sec" in e for e in validate_serving_record(bad))
+
+
+def test_shipped_serving_recipe_parses():
+    """The committed serving yaml's full Serving section (ckpt_dir
+    included) must round-trip through ServingConfig.from_dict — the
+    replica/bench entry points feed it verbatim (review finding: an
+    unknown-key assert killed every launch with the shipped recipe)."""
+    from fleetx_tpu.utils import config as config_mod
+
+    cfg = config_mod.parse_config(os.path.join(
+        REPO, "fleetx_tpu", "configs", "nlp", "gpt",
+        "serving_gpt_345M.yaml"))
+    sc = ServingConfig.from_dict(dict(cfg.get("Serving") or {}))
+    assert sc.ckpt_dir is None and sc.num_pages == 513
+    assert sc.max_seq_len <= 1024
+
+
+def test_perf_gate_serving_bands_skip_if_absent_and_catch_regression():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+
+    base = {"metric": "serving_poisson_tokens_per_s", "value": 500.0,
+            "serving": {"tokens_per_s": 500.0, "ttft_p99_s": 0.05,
+                        "itl_p99_s": 0.01, "refused": 0}}
+    # pre-serving baseline: every serving.* row skips, nothing fails
+    rows = perf_gate.compare(base, {"value": 500.0})
+    serving_rows = [r for r in rows if r["metric"].startswith("serving.")]
+    assert serving_rows and all(r["verdict"] == "skip"
+                                for r in serving_rows)
+    # identical serving capture passes
+    rows = perf_gate.compare(json.loads(json.dumps(base)), base)
+    assert not [r for r in rows if r["verdict"] == "FAIL"]
+    # 30% decode-throughput collapse + a tail blowup must FAIL
+    bad = json.loads(json.dumps(base))
+    bad["serving"]["tokens_per_s"] = 350.0
+    bad["serving"]["ttft_p99_s"] = 0.5
+    bad["value"] = 350.0
+    failed = {r["metric"] for r in perf_gate.compare(bad, base)
+              if r["verdict"] == "FAIL"}
+    assert "serving.tokens_per_s" in failed
+    assert "serving.ttft_p99_s" in failed
+
+
+def test_inference_predict_fetches_output_tree_in_one_device_get(
+        monkeypatch):
+    """The batch-predict path must device_get the WHOLE output tree once,
+    not leaf-by-leaf in a Python loop."""
+    from fleetx_tpu.core.engine.inference_engine import InferenceEngine
+
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+
+    class Stub:
+        mp = 1
+        dp = 1
+        params = None
+        _plain_call = staticmethod(
+            lambda params, *a: {"x": jnp.ones((2, 2)),
+                                "y": jnp.zeros((3,)),
+                                "z": jnp.ones((1, 4))})
+
+    out = InferenceEngine._predict(Stub(), [np.zeros((2, 2), np.int32)])
+    assert len(out) == 3 and all(isinstance(o, np.ndarray) for o in out)
+    assert len(calls) == 1, f"{len(calls)} device_get calls for one tree"
+
+
+# ---------------------------------------------------------------------------
+# subprocess drills: drain on SIGTERM, supervised 2-replica fleet
+# ---------------------------------------------------------------------------
+
+def _serve_yaml(tmp_path, name="serving.yaml", **serving_over):
+    serving = dict(max_batch=4, page_size=4, num_pages=33, max_seq_len=32,
+                   prefill_chunk=8)
+    serving.update(serving_over)
+    cfg = {"Model": MODEL_DICT, "Serving": serving,
+           "Generation": {"decode_strategy": "greedy_search",
+                          "eos_token_id": EOS, "pad_token_id": 0},
+           "Global": {"seed": 7}}
+    import yaml
+
+    path = tmp_path / name
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def _subprocess_env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single real CPU device is enough
+    env.update(extra)
+    return env
+
+
+def _wait_ready(path, proc, timeout=120.0):
+    """Poll for the replica's ready file; fail fast if it died."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except ValueError:
+                pass  # torn write — retry
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"replica died before ready (rc={proc.returncode})")
+        time.sleep(0.1)
+    raise AssertionError("replica never became ready")
+
+
+def _expected_tokens(prompts, max_new):
+    """What every replica must produce: params are deterministic from
+    Global.seed, so the in-process model predicts the fleet's output."""
+    from flax.core import meta
+
+    cfg = config_from_dict(MODEL_DICT)
+    model = GPTForPretraining(cfg)
+    params = meta.unbox(model.init({"params": jax.random.PRNGKey(7)},
+                                   jnp.zeros((1, 8), jnp.int32), None,
+                                   deterministic=True)["params"])
+    rows = one_shot(model, params, prompts, max_new)
+    out = []
+    for row in rows:
+        toks = [int(t) for t in row]
+        if EOS in toks:
+            toks = toks[:toks.index(EOS) + 1]
+        out.append(toks)
+    return out
+
+
+def _ask(port, payload, timeout=90.0):
+    from fleetx_tpu.serving.server import request
+
+    return request(("127.0.0.1", port), payload, timeout=timeout)
+
+
+@needs_net
+def test_replica_drains_on_injected_sigterm(tmp_path):
+    """``faults.py sigterm_at`` drill: the replica SIGTERMs itself after 6
+    work steps — guaranteed mid-stream (one request alone needs ~9 steps)
+    — then every ADMITTED request must complete token-correct before the
+    process exits with the preemption code; anything arriving after the
+    latch gets the explicit "draining" refusal (the router's re-dispatch
+    signal), never a silent drop."""
+    cfg_path = _serve_yaml(tmp_path)
+    ready = tmp_path / "ready.json"
+    metrics = tmp_path / "serving_metrics.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, SERVE, "-c", cfg_path, "--ready-file", str(ready),
+         "--metrics-out", str(metrics), "--preemption-code", "75"],
+        env=_subprocess_env(FLEETX_FAULTS="sigterm_at=6",
+                            FLEETX_FLIGHT_DIR=str(tmp_path / "flight")),
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        info = _wait_ready(str(ready), proc)
+        prompts = [[5, 9, 23, 41], [7, 3], [11, 2, 8]]
+        want = _expected_tokens(prompts, 8)
+        results = [None] * len(prompts)
+
+        def ask(i):
+            results[i] = _ask(info["port"],
+                              {"id": f"d{i}", "prompt": prompts[i],
+                               "max_new_tokens": 8})
+
+        threads = [threading.Thread(target=ask, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        rc = proc.wait(timeout=120)
+        assert rc == 75, f"expected preemption exit 75, got {rc}"
+        completed = 0
+        for i, resp in enumerate(results):
+            assert resp is not None, f"request {i} got no response"
+            if "tokens" in resp:
+                completed += 1
+                assert resp["tokens"] == want[i], (i, resp["tokens"],
+                                                   want[i])
+                assert resp["ttft_s"] is not None
+            else:
+                # a post-latch arrival: explicit refusal, not a drop
+                assert resp.get("error") == "draining", (i, resp)
+        assert completed >= 1, results  # the latch fired mid-stream
+        # the drained snapshot is on disk and schema-valid
+        lines = [l for l in open(metrics).read().splitlines() if l.strip()]
+        snap = json.loads(lines[-1])
+        assert validate_serving_record(snap) == []
+        assert snap["requests_completed"] == completed
+        # flight evidence of the drain landed in the ring dump
+        flights = list((tmp_path / "flight").glob("flight_rank*.json"))
+        assert flights, "no flight dump after drain"
+        events = json.loads(flights[0].read_text())["events"]
+        assert any(e.get("name") == "drain" for e in events)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@needs_net
+def test_supervised_fleet_kill_one_replica_loses_nothing(tmp_path):
+    """The acceptance drill (ISSUE 11): 2 replicas, each under its own
+    ``tools/supervise.py``, a router in front. One replica is SIGKILLed
+    mid-stream; the router must complete EVERY admitted request with
+    token-identical output (re-dispatch is idempotent — decode is a pure
+    function of the shared seeded params)."""
+    cfg_path = _serve_yaml(tmp_path)
+    ports = [_free_port(), _free_port()]
+    readys = [tmp_path / f"ready{i}.json" for i in range(2)]
+    sups = []
+    for i in range(2):
+        sups.append(subprocess.Popen(
+            [sys.executable, SUPERVISE, "--max-restart", "2",
+             "--backoff", "1.0", "--grace", "20", "--",
+             sys.executable, SERVE, "-c", cfg_path,
+             "--port", str(ports[i]), "--ready-file", str(readys[i]),
+             "--preemption-code", "75"],
+            env=_subprocess_env(
+                FLEETX_FLIGHT_DIR=str(tmp_path / f"flight{i}")),
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
+    router = None
+    try:
+        infos = [_wait_ready(str(r), s) for r, s in zip(readys, sups)]
+        router = subprocess.Popen(
+            [sys.executable, SERVE, "--router",
+             "--port", str(_free_port()),
+             "--backends",
+             f"127.0.0.1:{infos[0]['port']},127.0.0.1:{infos[1]['port']}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        line = router.stdout.readline()
+        assert "listening on" in line, line
+        router_port = int(line.split(":")[-1].split()[0])
+
+        rng = np.random.RandomState(3)
+        prompts = [[int(t) for t in rng.randint(1, 90, size=rng.randint(
+            2, 8))] for _ in range(10)]
+        want = _expected_tokens(prompts, 8)
+        results = [None] * len(prompts)
+        started = threading.Semaphore(0)
+
+        def ask(i):
+            if i >= 3:
+                started.acquire()  # the tail waits for the kill
+            results[i] = _ask(router_port,
+                              {"id": f"f{i}", "prompt": prompts[i],
+                               "max_new_tokens": 8}, timeout=150.0)
+
+        threads = [threading.Thread(target=ask, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        # let the head of the stream get in flight, then kill replica 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and \
+                not any(r is not None for r in results[:3]):
+            time.sleep(0.05)
+        os.kill(infos[0]["pid"], signal.SIGKILL)
+        for _ in range(len(prompts)):
+            started.release()
+        for t in threads:
+            t.join(timeout=180)
+        for i, resp in enumerate(results):
+            assert resp is not None, f"request {i} lost"
+            assert resp.get("tokens") == want[i], (i, resp, want[i])
+
+        # graceful fleet shutdown: the surviving replica's supervisor
+        # forwards SIGTERM → drain → preemption code (treated clean)
+        sups[1].send_signal(signal.SIGTERM)
+        rc1 = sups[1].wait(timeout=90)
+        assert rc1 == 75, f"survivor's supervisor exited {rc1}"
+    finally:
+        if router is not None and router.poll() is None:
+            router.kill()
+        for s in sups:
+            if s.poll() is None:
+                s.send_signal(signal.SIGTERM)
+        for s in sups:
+            if s.poll() is None:
+                try:
+                    s.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    s.kill()
+                    s.wait(timeout=30)

@@ -63,6 +63,20 @@ DECOMP_METRICS = {
     "decomposition.fwd_scan_ms_per_layer": ("lower", 0.10, 0.05),
     "decomposition.gap_ms": ("lower", 0.15, 1.0),
 }
+#: serving-bench SLOs (tools/serve.py --bench, docs/serving.md): decode
+#: throughput regresses DOWN, tail latencies UP. Bands are wider than the
+#: training ones (a Poisson stream adds arrival jitter on top of host
+#: scheduling) with absolute floors so millisecond-scale quantiles aren't
+#: failed on scheduler noise. Baselines without a serving entry skip —
+#: same stance as the pre-PR-10 decomposition metrics.
+SERVING_METRICS = {
+    "serving.tokens_per_s": ("higher", 0.15, 0.0),
+    "serving.ttft_p50_s": ("lower", 0.25, 0.005),
+    "serving.ttft_p99_s": ("lower", 0.25, 0.010),
+    "serving.itl_p50_s": ("lower", 0.25, 0.002),
+    "serving.itl_p99_s": ("lower", 0.25, 0.005),
+    "serving.refused": ("lower", 0.0, 0.5),  # abs: any new refusal fails
+}
 
 
 def _get_path(d: dict, dotted: str):
@@ -90,6 +104,7 @@ def compare(fresh: dict, base: dict,
     """
     specs = dict(GATE_METRICS)
     specs.update(DECOMP_METRICS)
+    specs.update(SERVING_METRICS)
     for key in sorted(set(list((base.get("span_means_ms") or {}))
                           + list((fresh.get("span_means_ms") or {})))):
         specs[f"span_means_ms.{key}"] = SPAN_TOL

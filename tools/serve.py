@@ -1,0 +1,203 @@
+"""Serving entry point: replica, router, or Poisson bench (docs/serving.md).
+
+One process = one role:
+
+- **replica** (default): build the model from ``-c cfg.yaml``, run one
+  ``ServingEngine`` behind the JSON-lines TCP front. SIGTERM/SIGINT latch
+  the PR 4/6 preemption handler → the replica stops admitting, finishes
+  every in-flight decode, flushes its serving metrics, and exits with
+  ``--preemption-code`` so ``tools/supervise.py`` treats the reclaim as a
+  clean stop (never a crash-restart)::
+
+      python tools/supervise.py --max-restart 3 -- \
+          python tools/serve.py -c serving_gpt_345M.yaml --port 9000
+
+- **router** (``--router``): the stdlib-only front over N replicas
+  (round-robin + least-outstanding, loss-free re-dispatch on replica
+  crash or drain)::
+
+      python tools/serve.py --router --port 8999 \
+          --backends 127.0.0.1:9000,127.0.0.1:9001
+
+- **bench** (``--bench``): the in-process Poisson serving bench; prints
+  one JSON line for ``tools/perf_gate.py``.
+
+Under a supervisor gang (``FLEETX_PROCESS_ID`` set) the replica offsets
+its port by the member id so one command line can launch N replicas on
+consecutive ports.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _build_engine(cfg: dict):
+    """Config sections → a ready ``ServingEngine`` (params from the
+    ``Serving.ckpt_dir`` checkpoint when given, else seeded init)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fleetx_tpu.core.engine.inference_engine import serving_mesh
+    from fleetx_tpu.models.gpt.model import GPTForPretraining, config_from_dict
+    from fleetx_tpu.serving.decode import SamplingParams
+    from fleetx_tpu.serving.engine import ServingConfig, ServingEngine
+
+    model_dict = dict(cfg.get("Model") or {})
+    quant = dict(cfg.get("Quantization") or {})
+    if quant.get("weight_bits"):
+        model_dict["qat_bits"] = int(quant["weight_bits"])
+    if quant.get("activation_bits"):
+        model_dict["qat_act_bits"] = int(quant["activation_bits"])
+    model_cfg = config_from_dict(model_dict)
+    serving = ServingConfig.from_dict(dict(cfg.get("Serving") or {}))
+
+    gen = dict(cfg.get("Generation") or {})
+    strategy = gen.get("decode_strategy") or "greedy_search"
+    sampling = SamplingParams(
+        do_sample=strategy == "sampling",
+        temperature=float(gen.get("temperature", 1.0)),
+        top_k=int(gen.get("top_k", 0)),
+        top_p=float(gen.get("top_p", 0.0)))
+    eos = int(gen.get("eos_token_id", 50256))
+
+    model = GPTForPretraining(model_cfg)
+    ckpt_dir = serving.ckpt_dir
+    if ckpt_dir:
+        from fleetx_tpu.core.checkpoint import load_params
+
+        params = load_params(str(ckpt_dir))
+    else:
+        seed = int((cfg.get("Global") or {}).get("seed", 0))
+        params = model.init(
+            {"params": jax.random.PRNGKey(seed)},
+            jnp.zeros((1, 8), jnp.int32), None, deterministic=True)["params"]
+    mesh = serving_mesh(cfg.get("Distributed"))
+    return ServingEngine(model_cfg, params, serving, sampling,
+                         eos_token_id=eos, mesh=mesh,
+                         seed=int((cfg.get("Global") or {}).get("seed", 0)))
+
+
+def _run_replica(args, cfg: dict) -> int:
+    """Replica role: engine + socket front + preemption-drain loop."""
+    from fleetx_tpu.observability.flight import FlightRecorder, install
+    from fleetx_tpu.observability import flight
+    from fleetx_tpu.resilience.faults import FaultPlan, install_plan
+    from fleetx_tpu.resilience.preemption import PreemptionHandler
+    from fleetx_tpu.serving.server import ReplicaServer
+    from fleetx_tpu.utils.log import logger
+
+    flight_dir = os.environ.get("FLEETX_FLIGHT_DIR") or "./flight_recorder"
+    install(FlightRecorder(flight_dir))
+
+    plan = FaultPlan.from_cfg(
+        dict((cfg.get("Resilience") or {}).get("faults") or {}))
+    install_plan(plan)
+
+    port = args.port
+    member = os.environ.get("FLEETX_PROCESS_ID")
+    if port and member:
+        port += int(member)
+
+    engine = _build_engine(cfg)
+    server = ReplicaServer(engine, host=args.host, port=port,
+                           fault_plan=plan if plan.armed else None)
+    bound = server.start()
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            json.dump({"pid": os.getpid(), "port": bound}, f)
+    handler = PreemptionHandler()
+    with handler.installed():
+        try:
+            server.run(preemption=handler)
+        finally:
+            server.close()
+    if args.metrics_out:
+        with open(args.metrics_out, "a") as f:
+            f.write(json.dumps(engine.serving_snapshot()) + "\n")
+    flight.dump("serving preemption drain")
+    logger.warning("replica drained — exiting with preemption code %d",
+                   args.preemption_code)
+    return args.preemption_code
+
+
+def _run_bench(args, cfg: dict) -> int:
+    """Bench role: in-process Poisson load, one JSON line on stdout."""
+    from fleetx_tpu.serving import bench as B
+
+    engine = _build_engine(cfg)
+    bcfg = dict(cfg.get("ServingBench") or {})
+    result = B.run_serving_bench(
+        engine,
+        n_requests=args.requests or int(bcfg.get("requests", 32)),
+        rate_rps=args.rate or float(bcfg.get("rate_rps", 8.0)),
+        max_prompt=int(bcfg.get("max_prompt", 24)),
+        max_new=int(bcfg.get("max_new", 16)),
+        seed=args.seed,
+        metric=str(bcfg.get("metric", "serving_poisson_tokens_per_s")))
+    B.emit(result, out=args.json_out)
+    return 0
+
+
+def main(argv=None) -> int:
+    """CLI dispatch across the three roles."""
+    ap = argparse.ArgumentParser(description="fleetx serving runtime")
+    ap.add_argument("-c", "--config", help="YAML config (replica/bench)")
+    ap.add_argument("-o", "--override", action="append", default=[],
+                    help="dotted config overrides")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = OS-assigned; offset by "
+                         "FLEETX_PROCESS_ID under a supervisor gang)")
+    ap.add_argument("--ready-file", default=None,
+                    help="write {pid, port} JSON here once listening")
+    ap.add_argument("--metrics-out", default=None,
+                    help="append the final serving snapshot JSONL here")
+    ap.add_argument("--preemption-code", type=int, default=75,
+                    help="exit code after a graceful drain (match "
+                         "tools/supervise.py --preemption-code)")
+    ap.add_argument("--router", action="store_true",
+                    help="run the request router instead of a replica")
+    ap.add_argument("--backends", default=None,
+                    help="router mode: comma-separated host:port replicas")
+    ap.add_argument("--bench", action="store_true",
+                    help="run the Poisson serving bench and exit")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="bench: request count (0 = config/default)")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="bench: Poisson arrival rate, req/s")
+    ap.add_argument("--seed", type=int, default=0, help="bench: stream seed")
+    ap.add_argument("--json-out", default=None,
+                    help="bench: also write the JSON line to this path")
+    args = ap.parse_args(argv)
+
+    if args.router:
+        from fleetx_tpu.serving.router import main as router_main
+
+        if not args.backends:
+            ap.error("--router requires --backends host:port,host:port")
+        return router_main(["--port", str(args.port), "--host", args.host,
+                            "--backends", args.backends])
+
+    if not args.config:
+        ap.error("replica/bench mode requires -c config.yaml")
+    from fleetx_tpu.utils import config as config_mod
+
+    # parse + override only: the training post-processing (batch-size
+    # derivations, LR math) has no meaning for a serving process
+    cfg = config_mod.parse_config(args.config)
+    config_mod.override_config(cfg, args.override)
+    if args.bench:
+        return _run_bench(args, cfg)
+    return _run_replica(args, cfg)
+
+
+if __name__ == "__main__":
+    # die by default signal only until the preemption handler is installed;
+    # afterwards SIGTERM means "drain gracefully"
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    sys.exit(main())
