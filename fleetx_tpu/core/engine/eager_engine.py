@@ -828,7 +828,9 @@ class EagerEngine(BasicEngine):
         base_consumed = self._consumed_samples
         if start_step >= self.max_steps:
             logger.info("checkpoint already at step %d >= max_steps", start_step)
-            return
+            # pre-agreed: start_step is the restored checkpoint step, which
+            # load() takes from a rank-0 broadcast — uniform across ranks
+            return  # fleetx: noqa[FX008] -- resume step is gang-agreed
         if self.run_mode == "epoch" and self._start_epoch >= epoch_num:
             logger.info("checkpoint already at epoch %d >= epoch_num %d",
                         self._start_epoch, epoch_num)
@@ -1049,14 +1051,21 @@ class EagerEngine(BasicEngine):
                         f"{good_local})")
                 # tear the whole input pipeline down BEFORE rewinding: the
                 # old DataLoader producer must be joined, or its last
-                # sampler advance could stomp the rewound consumed_samples
-                if not close_stream():
+                # sampler advance could stomp the rewound consumed_samples.
+                # A wedged producer is a RANK-LOCAL fact — vote it (like
+                # the rewind-dry case below) so the refusal aborts every
+                # rank together instead of stranding healthy peers in
+                # 'rollback_exit' until CoordinationTimeout (lint: FX008)
+                pipeline_wedged = not close_stream()
+                if self.coord.any_flag("rollback_pipeline_wedged",
+                                       pipeline_wedged):
                     # a hung producer still owns the sampler — a rewind
                     # now could be silently overwritten; refuse
                     raise TrainingAborted(
                         "rollback aborted: the input pipeline did not shut "
-                        "down cleanly, the data position cannot be safely "
-                        "rewound")
+                        "down cleanly" + ("" if pipeline_wedged
+                                          else " on a peer rank")
+                        + ", the data position cannot be safely rewound")
                 self.load(self.output_dir)
                 restored = int(jax.device_get(self.state.step))
                 skip = 0
@@ -1072,11 +1081,21 @@ class EagerEngine(BasicEngine):
                     skip = max((self._consumed_samples - base_consumed)
                                // global_batch, 0)
                 bi = iter(host_batches(start_index=restored - skip))
+                # a dry stream here is a RANK-LOCAL fact (each host owns
+                # its shard): raising before the exit barrier would leave
+                # the healthy peers wedged in 'rollback_exit' until
+                # CoordinationTimeout (lint: FX008), so the failure is
+                # voted first and every rank aborts together
+                rewind_dry = False
                 for _ in range(skip):
                     if next(bi, None) is None:
-                        raise TrainingAborted(
-                            "data stream exhausted while rewinding for "
-                            "rollback")
+                        rewind_dry = True
+                        break
+                if self.coord.any_flag("rollback_rewind_dry", rewind_dry):
+                    raise TrainingAborted(
+                        "data stream exhausted while rewinding for "
+                        "rollback" + ("" if rewind_dry
+                                      else " on a peer rank"))
                 self._epoch = self._start_epoch
                 final_epoch[0] = self._start_epoch
                 res.registry.counter("rollbacks_total").inc()
@@ -1141,7 +1160,9 @@ class EagerEngine(BasicEngine):
                     if step >= self.max_steps:
                         stream_done = True
                 elif step >= self.max_steps:
-                    break
+                    # single-process arm: gang mode reaches max_steps via
+                    # stream_done + the loop-control vote above, never here
+                    break  # fleetx: noqa[FX008] -- off-gang arm (LocalCoordinator)
                 res.faults.maybe_sigterm(step, start_step=start_step)
                 if gang_loop:
                     # fetch BEFORE the control vote so stream exhaustion
@@ -1200,18 +1221,27 @@ class EagerEngine(BasicEngine):
                                     # two-phase commit rendezvous with
                                     # ONLY a healthy vote, skipping the
                                     # redundant state write
-                                    ckpt_lib.join_commit_vote()
+                                    ckpt_lib.join_commit_vote()  # fleetx: noqa[FX007] -- both arms join the same ckpt_commit rendezvous
                                 else:
                                     last_save = step
-                                    self.save()
-                        continue
+                                    self.save()  # fleetx: noqa[FX007] -- both arms join the same ckpt_commit rendezvous
+                        # idle in lockstep, never a unilateral exit: every
+                        # vote and save rendezvous above was matched, and
+                        # vote_every is forced to 1 whenever the loop body
+                        # has same-iteration collectives (guard/sentinel/
+                        # shared mesh), so peers never outpace this rank
+                        continue  # fleetx: noqa[FX008] -- idle path matches every rendezvous; exit is voted
                 else:
                     if res.preempted:
-                        preemption_exit()
+                        # single-process arm: gang mode latches preemption
+                        # through the loop-control vote, never here
+                        preemption_exit()  # fleetx: noqa[FX007] -- off-gang arm (LocalCoordinator)
                     item = fetch_item()
                     if item is None:
                         self._epoch = final_epoch[0]
-                        break
+                        # single-process arm: gang mode turns stream
+                        # exhaustion into a voted 'done' flag above
+                        break  # fleetx: noqa[FX008] -- off-gang arm (LocalCoordinator)
                 self._epoch, payload = item
                 self.profiler.maybe_start(step)
                 if prefetcher is not None:
@@ -1264,7 +1294,7 @@ class EagerEngine(BasicEngine):
                     # block on a wedged peer, so the stall detector is
                     # suspended like every other long host phase
                     with self.obs.timed_span("sdc_sentinel"), wd_quiet():
-                        self._sdc_check(prev_state, sharded, metrics,
+                        self._sdc_check(prev_state, sharded, metrics,  # fleetx: noqa[FX009] -- gang arm keys on lockstep vote_round; the step arm is single-process
                                         step, gang_loop)
                 if res.faults.take_bitflip(step):
                     # the silent-HBM-corruption drill: flips a bit AFTER
@@ -1298,17 +1328,20 @@ class EagerEngine(BasicEngine):
                     self._emit_train_record(log_dict, host_metrics)
                     if res.guard is not None:
                         fin = host_metrics.get("finite")
-                        decision = res.guard.observe(
+                        local_decision = res.guard.observe(
                             step, loss,
                             finite=None if fin is None else bool(fin))
-                        if self.coord.world > 1:
-                            # collective verdict: any rank's NaN streak
-                            # rolls EVERYONE back, any abort aborts all —
-                            # no rank takes a recovery action its peers
-                            # don't mirror in the same window
-                            decision = coordination.most_severe(
-                                self.coord.all_gather(
-                                    "guard_decision", decision).values())
+                        # collective verdict: any rank's NaN streak rolls
+                        # EVERYONE back, any abort aborts all — no rank
+                        # takes a recovery action its peers don't mirror
+                        # in the same window. Unconditional (the local
+                        # coordinator's gather is a no-op) so the verdict
+                        # below is an agreement result, provably
+                        # gang-uniform — not a rank-local readback
+                        # (lint: FX007 rank-taint sanitizer)
+                        decision = coordination.most_severe(
+                            self.coord.all_gather(
+                                "guard_decision", local_decision).values())
                         if decision is not None:
                             flight.note("guard", str(decision),
                                         step=int(step), loss=loss)
@@ -1370,7 +1403,7 @@ class EagerEngine(BasicEngine):
                     last_save = step
                     last_save_round = vote_round
                     with wd_quiet():
-                        self.save()
+                        self.save()  # fleetx: noqa[FX009] -- gang arm keys save_due on lockstep vote_round; the step-keyed arm is single-process
                 if self._fault_step and start_step == 0 and \
                         step >= self._fault_step:
                     # fault injection (tests/tools/supervise.py): die hard on

@@ -12,6 +12,11 @@ AST-based rule framework that catches those classes at commit time:
 - ``PartitionSpec`` axis names that the mesh never declares,
 - Python ``if``/``while`` on traced values,
 - config keys no code consumes (and code sections no config provides),
+- and — v2, on the interprocedural dataflow engine in ``dataflow.py``
+  (call graph + CFG + rank-taint lattice) — the gang-collective lockstep
+  rules: collectives under rank-divergent guards (FX007), unmatched
+  agreement pairings / unilateral loop exits (FX008), step-keyed gang
+  triggers (FX009) and loop-varying jit retrace hazards (FX010),
 - plus the docstring conventions previously enforced by
   ``codestyle/check_docstrings.py``, unified under the same registry,
   suppression syntax and exit-code convention.
@@ -32,4 +37,8 @@ from fleetx_tpu.lint.core import (  # noqa: F401
     register,
     run_lint,
 )
-from fleetx_tpu.lint.reporters import render_json, render_text  # noqa: F401
+from fleetx_tpu.lint.reporters import (  # noqa: F401
+    render_json,
+    render_sarif,
+    render_text,
+)

@@ -293,7 +293,12 @@ def donated_bindings(tree: ast.AST,
 
 def own_statements(fn: ast.AST) -> Iterator[ast.stmt]:
     """Statements of ``fn`` recursively, NOT descending into nested defs."""
-    stack: list[ast.stmt] = list(fn.body)
+    yield from own_statements_of_body(fn.body)
+
+
+def own_statements_of_body(body: list) -> Iterator[ast.stmt]:
+    """:func:`own_statements` over a bare statement list (loop bodies)."""
+    stack: list[ast.stmt] = list(body)
     while stack:
         stmt = stack.pop(0)
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,

@@ -1,0 +1,157 @@
+"""Content-fingerprint result cache — the grown repo lints in seconds.
+
+Two granularities, matching the two rule scopes in ``core.Rule``:
+
+- **module-scope** rules (FX001-FX005, FX010, docstrings) depend only on
+  one file's text plus a small stable context (FX004's mesh axes).  Their
+  findings are cached per ``(relpath, sha1(text), rule, context_key)``.
+- **project-scope** rules (FX006-FX009) read cross-file state — the config
+  zoo, the call graph over ``fleetx_tpu/`` + ``tools/`` + ``tasks/``.
+  Their findings are cached against a whole-project content digest; any
+  file change re-runs them (correct by construction, and the no-change
+  case — CI re-running ``tools/lint.py`` for the gate, ``--changed-only``
+  with a clean tree — is the one worth making instant).
+
+Cached findings are raw: fingerprints, ``noqa`` suppression and baseline
+filtering are recomputed on every run (they read current line text), so a
+stale suppression can never hide behind the cache.  The cache file itself
+is versioned and silently discarded on any mismatch or decode error —
+a corrupt cache costs one cold run, never a wrong result.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import List, Optional
+
+from fleetx_tpu.lint.core import Finding
+
+#: bump on FORMAT changes; rule-SEMANTICS changes are handled automatically
+#: by :func:`linter_fingerprint` below
+CACHE_VERSION = 2
+
+
+def linter_fingerprint() -> str:
+    """Content hash of the linter's own source (``fleetx_tpu/lint/**``).
+
+    Folded into the cache validity check so editing a rule implementation
+    invalidates every stored result automatically — without this, a
+    module-scope entry keyed only on the TARGET file's sha would keep
+    serving pre-edit findings and the whole-repo gate would pass on stale
+    results.  Cached on first call (the file set is fixed per process).
+    """
+    global _LINTER_FP
+    if _LINTER_FP is None:
+        h = hashlib.sha1()
+        pkg = Path(__file__).resolve().parent
+        for f in sorted(pkg.rglob("*.py")):
+            try:
+                payload = f.read_bytes()
+            except OSError:
+                continue
+            h.update(f"{f.relative_to(pkg).as_posix()}\0".encode("utf-8"))
+            h.update(hashlib.sha1(payload).digest())
+        _LINTER_FP = h.hexdigest()
+    return _LINTER_FP
+
+
+_LINTER_FP: Optional[str] = None
+
+_FIELDS = ("rule", "code", "path", "line", "col", "message")
+
+
+def _encode(findings: List[Finding]) -> list:
+    return [{k: getattr(f, k) for k in _FIELDS} for f in findings]
+
+
+def _decode(raw: list) -> Optional[List[Finding]]:
+    out = []
+    try:
+        for d in raw:
+            out.append(Finding(**{k: d[k] for k in _FIELDS}))
+    except (KeyError, TypeError):
+        return None
+    return out
+
+
+class ParseCache:
+    """JSON-backed finding cache (best-effort: I/O errors degrade to a
+    cold run, they never fail the lint)."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self._modules: dict = {}
+        self._project: dict = {}
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        try:
+            data = json.loads(self.path.read_text(encoding="utf-8"))
+            if data.get("version") == CACHE_VERSION and \
+                    data.get("linter") == linter_fingerprint() and \
+                    isinstance(data.get("modules"), dict) and \
+                    isinstance(data.get("project"), dict):
+                self._modules = data["modules"]
+                self._project = data["project"]
+        except (OSError, ValueError):
+            pass
+
+    # ------------------------------------------------------------- modules
+    def get_module(self, relpath: str, sha1: str, rule: str,
+                   context_key: str) -> Optional[List[Finding]]:
+        """Cached findings of one module-scope rule on one file, or None
+        when the content/context fingerprint no longer matches."""
+        entry = self._modules.get(f"{relpath}::{rule}")
+        if not entry or entry.get("key") != f"{sha1}|{context_key}":
+            self.misses += 1
+            return None
+        decoded = _decode(entry.get("findings", []))
+        if decoded is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return decoded
+
+    def put_module(self, relpath: str, sha1: str, rule: str,
+                   context_key: str, findings: List[Finding]) -> None:
+        """Store one (file, rule) result under its content fingerprint."""
+        self._modules[f"{relpath}::{rule}"] = {
+            "key": f"{sha1}|{context_key}", "findings": _encode(findings)}
+        self._dirty = True
+
+    # ------------------------------------------------------------- project
+    def get_project(self, rule: str,
+                    digest: str) -> Optional[List[Finding]]:
+        """Cached findings of one project-scope rule, or None when the
+        whole-project digest changed."""
+        entry = self._project.get(rule)
+        if not entry or entry.get("key") != digest:
+            self.misses += 1
+            return None
+        decoded = _decode(entry.get("findings", []))
+        if decoded is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return decoded
+
+    def put_project(self, rule: str, digest: str,
+                    findings: List[Finding]) -> None:
+        """Store one project-scope rule result under the project digest."""
+        self._project[rule] = {"key": digest, "findings": _encode(findings)}
+        self._dirty = True
+
+    # --------------------------------------------------------------- flush
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        payload = {"version": CACHE_VERSION, "linter": linter_fingerprint(),
+                   "modules": self._modules, "project": self._project}
+        try:
+            tmp = self.path.with_suffix(self.path.suffix + ".tmp")
+            tmp.write_text(json.dumps(payload), encoding="utf-8")
+            tmp.replace(self.path)
+        except OSError:
+            pass

@@ -33,6 +33,21 @@ CONSUMER_DIRS = ("fleetx_tpu", "tools", "tasks")
 CONFIG_DIRS = ("fleetx_tpu/configs", "projects")
 
 
+def iter_context_files(root: Path) -> Iterator[Path]:
+    """Every python file under the cross-file context dirs.
+
+    This is THE shared surface: ``Project.consumer_trees`` (FX006's
+    consumption set), ``Project.digest`` (project-rule cache invalidation)
+    and the dataflow call graph all iterate exactly this — keeping the
+    walks structurally identical is what makes the digest's "covers
+    everything the cross-file rules read" claim true by construction.
+    """
+    for d in CONSUMER_DIRS:
+        base = Path(root) / d
+        if base.is_dir():
+            yield from sorted(base.rglob("*.py"))
+
+
 @dataclasses.dataclass
 class Finding:
     """One diagnostic: a rule, a location, and a message."""
@@ -65,6 +80,10 @@ class Rule:
     description: str = ""
     #: True for rules that read the YAML config zoo (affects the file count)
     scans_configs: bool = False
+    #: "module" — findings depend on one file (+ ``context_key``), cached
+    #: per file; "project" — findings read cross-file state (config zoo,
+    #: call graph), cached against the whole-project digest
+    scope: str = "module"
 
     def check_module(self, module: "SourceModule",
                      project: "Project") -> Iterable[Finding]:
@@ -72,6 +91,11 @@ class Rule:
 
     def check_project(self, project: "Project") -> Iterable[Finding]:
         return ()
+
+    def context_key(self, project: "Project") -> str:
+        """Extra cache discriminator for module-scope rules whose result
+        also depends on a stable project fact (FX004: the mesh axes)."""
+        return ""
 
     def finding(self, path: str, line: int, col: int, message: str) -> Finding:
         return Finding(rule=self.name, code=self.code, path=path,
@@ -131,6 +155,15 @@ class SourceModule:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text)  # SyntaxError handled by the runner
+        self._sha1: Optional[str] = None
+
+    @property
+    def sha1(self) -> str:
+        """Content fingerprint (drives the parse/result cache)."""
+        if self._sha1 is None:
+            self._sha1 = hashlib.sha1(
+                self.text.encode("utf-8")).hexdigest()
+        return self._sha1
 
 
 class Project:
@@ -144,6 +177,7 @@ class Project:
         self.config_paths: list[Path] = []
         self._lines_cache: dict[str, list[str]] = {}
         self._mesh_axes: Optional[tuple] = None
+        self._digest: Optional[str] = None
         self._collect()
 
     # ------------------------------------------------------------ collection
@@ -253,19 +287,47 @@ class Project:
         for m in self.modules:
             seen.add(m.relpath)
             yield m.tree
-        for d in CONSUMER_DIRS:
-            base = self.root / d
-            if not base.is_dir():
+        for f in iter_context_files(self.root):
+            rel = self.relpath(f)
+            if rel in seen:
                 continue
-            for f in sorted(base.rglob("*.py")):
-                rel = self.relpath(f)
-                if rel in seen:
-                    continue
-                seen.add(rel)
-                try:
-                    yield ast.parse(f.read_text(encoding="utf-8"))
-                except (SyntaxError, OSError):
-                    continue
+            seen.add(rel)
+            try:
+                yield ast.parse(f.read_text(encoding="utf-8"))
+            except (SyntaxError, OSError):
+                continue
+
+    def digest(self) -> str:
+        """Whole-project content fingerprint for project-scope rule caching.
+
+        Covers the scanned modules, every python file a project-scope rule
+        may read for cross-file context (``CONSUMER_DIRS`` — the same
+        surface the call graph and the config-consumption set are built
+        from) and the YAML config zoo; any byte change anywhere in that
+        set invalidates every project-scope cache entry.
+        """
+        if self._digest is not None:
+            return self._digest
+        h = hashlib.sha1()
+        seen: set[str] = set()
+        for m in self.modules:
+            seen.add(m.relpath)
+            h.update(f"{m.relpath}\0{m.sha1}\0".encode("utf-8"))
+        extras: list[Path] = list(iter_context_files(self.root))
+        extras.extend(self.config_files())
+        for f in extras:
+            rel = self.relpath(f)
+            if rel in seen:
+                continue
+            seen.add(rel)
+            try:
+                payload = f.read_bytes()
+            except OSError:
+                continue
+            h.update(f"{rel}\0".encode("utf-8"))
+            h.update(hashlib.sha1(payload).digest())
+        self._digest = h.hexdigest()
+        return self._digest
 
 
 @dataclasses.dataclass
@@ -339,12 +401,17 @@ def write_baseline(path: Path, findings: list[Finding]) -> None:
 def run_lint(paths: Iterable[Any], root: Any = None,
              select: Iterable[str] | None = None,
              skip: Iterable[str] | None = None,
-             baseline_path: Any = None) -> LintResult:
+             baseline_path: Any = None,
+             cache_path: Any = None,
+             only_paths: Iterable[str] | None = None) -> LintResult:
     """Lint ``paths`` and return the filtered result.
 
     ``root`` anchors cross-file facts (mesh axes, config zoo, consumption
     set); it defaults to the common parent of ``paths`` so fixture projects
-    in a tmp dir are self-contained.
+    in a tmp dir are self-contained.  ``cache_path`` enables the
+    content-fingerprint result cache (``lint/cache.py``).  ``only_paths``
+    restricts *reported* findings to those relpaths while the full scan
+    still provides cross-file context (the ``--changed-only`` contract).
     """
     path_objs = [Path(p) for p in paths]
     if root is None:
@@ -352,11 +419,17 @@ def run_lint(paths: Iterable[Any], root: Any = None,
     project = Project(Path(root), path_objs)
     rules = resolve_rules(select, skip)
 
+    cache = None
+    if cache_path is not None:
+        from fleetx_tpu.lint.cache import ParseCache
+
+        cache = ParseCache(cache_path)
+
     findings: list[Finding] = list(project.broken)
     for rule in rules:
-        findings.extend(rule.check_project(project))
-        for module in project.modules:
-            findings.extend(rule.check_module(module, project))
+        findings.extend(_run_rule(rule, project, cache))
+    if cache is not None:
+        cache.save()
     fingerprint_findings(findings, project)
 
     accepted = load_baseline(Path(baseline_path)) if baseline_path else set()
@@ -370,6 +443,11 @@ def run_lint(paths: Iterable[Any], root: Any = None,
             baselined.append(f)
         else:
             active.append(f)
+    if only_paths is not None:
+        keep = set(only_paths)
+        active = [f for f in active if f.path in keep]
+        suppressed = [f for f in suppressed if f.path in keep]
+        baselined = [f for f in baselined if f.path in keep]
     active.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     # config files count as "checked" only when a config-reading rule ran
     n_configs = (len(project.config_files())
@@ -377,6 +455,37 @@ def run_lint(paths: Iterable[Any], root: Any = None,
     return LintResult(findings=active, suppressed=suppressed,
                       baselined=baselined, rules=[r.name for r in rules],
                       files=len(project.modules) + n_configs)
+
+
+def _run_rule(rule: Rule, project: Project, cache) -> list[Finding]:
+    """One rule over the project, through the result cache when enabled."""
+    if cache is None:
+        out = list(rule.check_project(project))
+        for module in project.modules:
+            out.extend(rule.check_module(module, project))
+        return out
+    if rule.scope == "project":
+        digest = f"{project.digest()}|{rule.context_key(project)}"
+        cached = cache.get_project(rule.name, digest)
+        if cached is not None:
+            return cached
+        out = list(rule.check_project(project))
+        for module in project.modules:
+            out.extend(rule.check_module(module, project))
+        cache.put_project(rule.name, digest, out)
+        return out
+    out = list(rule.check_project(project))
+    ctx = rule.context_key(project)
+    for module in project.modules:
+        cached = cache.get_module(module.relpath, module.sha1,
+                                  rule.name, ctx)
+        if cached is not None:
+            out.extend(cached)
+            continue
+        got = list(rule.check_module(module, project))
+        cache.put_module(module.relpath, module.sha1, rule.name, ctx, got)
+        out.extend(got)
+    return out
 
 
 def _common_root(paths: list[Path]) -> Path:

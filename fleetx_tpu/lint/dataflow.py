@@ -1,0 +1,717 @@
+"""Project-level dataflow: call graph, per-function CFGs, rank-taint lattice.
+
+PRs 6-8 added gang-collective lockstep contracts (every rank must issue the
+same agreement primitives in the same order — ``resilience/coordination.py``)
+and their review history was dominated by ONE bug class: a collective
+reachable under control flow keyed on rank-local state.  Catching that class
+needs more than the per-file AST walks in ``lint/analysis.py``:
+
+1. a **call graph** over the scanned modules plus the ``tools/``/``tasks/``
+   driver surface, with a transitive *may-perform-collective* summary per
+   function (``self.save()`` is a gang rendezvous three calls down);
+2. an intra-procedural **CFG** per function (statement granularity), so the
+   pairing rule can enumerate paths between paired agreement calls and name
+   the early ``return``/``raise``/``break`` that escapes between them;
+3. a **rank-taint lattice** per function: which names (may) hold values
+   that differ across ranks.  Sources: ``process_index``/``.rank`` reads,
+   rank-keyed environment lookups, device readbacks (``jax.device_get`` /
+   ``.item()`` — per-rank under the in-step non-finite skip), per-rank
+   stream reads (``next()``), counters incremented under a rank-divergent
+   guard, and rank-local I/O exception handlers.  Sanitizers: the agreement
+   primitives themselves — a ``broadcast``/``all_gather``/``majority``/
+   ``any_flag`` result is gang-uniform by construction.
+
+Everything here is a *may* analysis: taint joins are unions, call edges are
+name-resolved through the module's imports (no inheritance walk), and the
+CFG adds exceptional edges only for explicit ``raise`` statements.  The
+rules built on top (FX007-FX009 in ``rules/collectives.py``) therefore
+over-approximate; provably pre-agreed divergence is silenced inline with
+``# fleetx: noqa[rule] -- reason`` per docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from fleetx_tpu.lint import analysis
+
+#: coordinator agreement methods — calling one IS a gang collective
+COORD_METHODS = {"barrier", "broadcast", "any_flag", "all_gather", "majority"}
+
+#: agreement methods whose RESULT is gang-uniform (taint sanitizers);
+#: ``barrier`` returns None so it never launders a value
+SANITIZER_METHODS = {"broadcast", "any_flag", "all_gather", "majority"}
+
+#: resolved dotted names of in-program (XLA) collectives — a rank-divergent
+#: guard around one of these wedges the mesh exactly like a KV-store one
+LAX_COLLECTIVES = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.all_gather", "jax.lax.all_to_all", "jax.lax.ppermute",
+    "jax.lax.psum_scatter", "jax.lax.pswapaxes",
+}
+
+#: every function under this prefix is a cross-process rendezvous
+MULTIHOST_PREFIX = "jax.experimental.multihost_utils."
+
+#: attribute reads that yield a rank-local value
+RANK_SOURCE_ATTRS = {"rank", "process_index", "preempted"}
+
+#: attribute reads that are gang-uniform even off a rank-local receiver
+#: (every rank sees the same world size — ``coord.world == 1`` guards are
+#: the canonical "no peers to strand" branch)
+UNIFORM_ATTRS = {"world"}
+
+#: resolved call targets that yield a rank-local value
+RANK_SOURCE_CALLS = {"jax.process_index"}
+
+#: resolved call targets that read a per-rank device value back to the host
+READBACK_CALLS = {"jax.device_get"}
+READBACK_ATTRS = {"item", "tolist"}
+
+#: environment keys that identify the process (rank-local by definition)
+RANK_ENV_KEYS = {"PROCESS_ID", "RANK", "LOCAL_RANK", "NODE_RANK",
+                 "PROCESS_INDEX", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"}
+
+#: exception types whose handler body runs only on the rank that hit the
+#: (rank-local) I/O fault — control flow inside is rank-divergent
+IO_EXCEPTIONS = {"OSError", "IOError", "FileNotFoundError", "NotADirectoryError",
+                 "PermissionError", "TimeoutError", "ConnectionError",
+                 "BlockingIOError", "InterruptedError", "StopIteration",
+                 "EOFError"}
+
+# the call graph parses the same cross-file surface as FX006's consumption
+# set and the project digest (core.iter_context_files) — files there are
+# *context* even when out of lint scope: a guarded ``self.save()`` in the
+# engine is only known collective because checkpoint.py's vote is visible
+
+
+@dataclasses.dataclass
+class Taint:
+    """One lattice element: ``kind`` selects the reporting rule.
+
+    ``kind == "rank"`` — plain rank-divergent value (FX007 shapes);
+    ``kind == "mod"``  — a modulo over a rank-local counter (the FX009
+    step-keyed trigger shape; it stays "mod" through comparisons and
+    boolean algebra so ``step % k == 0 and step != last`` keeps the
+    specific diagnosis).
+    """
+
+    kind: str
+    reason: str
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function in the project call graph."""
+
+    qualname: str           # e.g. "fleetx_tpu/core/checkpoint.py::save_checkpoint"
+    relpath: str
+    node: ast.AST           # FunctionDef | AsyncFunctionDef
+    aliases: dict
+    cls: Optional[str] = None   # enclosing class name, if a method
+    in_scope: bool = True       # False for context-only (tools/tasks) modules
+
+
+# --------------------------------------------------------------------- CFG
+
+ENTRY = "<entry>"
+EXIT = "<exit>"
+
+
+class CFG:
+    """Statement-granularity control-flow graph of one function body.
+
+    Nodes are ``id(stmt)`` keys (plus the ``ENTRY``/``EXIT`` sentinels);
+    edges follow structured control flow, ``break``/``continue`` jump to
+    their loop's follow/head, ``return`` goes to ``EXIT`` and ``raise``
+    goes to the nearest enclosing handler set (or ``EXIT`` when none).
+    Only explicit ``raise`` statements get exceptional edges — implicit
+    exception paths out of arbitrary calls are out of scope (documented
+    in docs/static_analysis.md "Scope and limits").
+    """
+
+    def __init__(self, fn: ast.AST):
+        self.succ: Dict[object, Set[object]] = {ENTRY: set(), EXIT: set()}
+        self.stmts: Dict[object, ast.stmt] = {}
+        entry = self._seq(fn.body, EXIT, loops=[], tries=[], finals=[])
+        self.succ[ENTRY].add(entry)
+
+    # -- construction -------------------------------------------------------
+    def _key(self, stmt: ast.stmt) -> object:
+        self.stmts[id(stmt)] = stmt
+        self.succ.setdefault(id(stmt), set())
+        return id(stmt)
+
+    def _seq(self, stmts: List[ast.stmt], follow: object,
+             loops: list, tries: list, finals: list) -> object:
+        """Wire a statement sequence; returns the entry key (or ``follow``)."""
+        entry = follow
+        for stmt in reversed(stmts):
+            entry = self._stmt(stmt, entry, loops, tries, finals)
+        return entry
+
+    def _stmt(self, stmt: ast.stmt, follow: object,
+              loops: list, tries: list, finals: list) -> object:
+        key = self._key(stmt)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            self.succ[key].add(follow)   # a def is one opaque statement
+        elif isinstance(stmt, ast.If):
+            self.succ[key].add(self._seq(stmt.body, follow, loops, tries,
+                                         finals))
+            self.succ[key].add(self._seq(stmt.orelse, follow, loops, tries,
+                                         finals) if stmt.orelse else follow)
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            # loop head evaluates the test/iter each round
+            body = self._seq(stmt.body, key, loops + [(key, follow)], tries,
+                             finals)
+            self.succ[key].add(body)
+            exit_edge = (self._seq(stmt.orelse, follow, loops, tries, finals)
+                         if stmt.orelse else follow)
+            self.succ[key].add(exit_edge)
+        elif isinstance(stmt, ast.Break):
+            # an abrupt exit runs every enclosing finally first — routing
+            # through the innermost finalbody (not straight to the target)
+            # is what lets `try: ... finally: barrier("x_exit")` CLOSE a
+            # pairing; the over-approximation (flow continues after the
+            # finally) trades a narrow false negative for never flagging
+            # the canonical cleanup idiom
+            self.succ[key].add(finals[-1] if finals
+                               else (loops[-1][1] if loops else follow))
+        elif isinstance(stmt, ast.Continue):
+            self.succ[key].add(finals[-1] if finals
+                               else (loops[-1][0] if loops else follow))
+        elif isinstance(stmt, ast.Return):
+            self.succ[key].add(finals[-1] if finals else EXIT)
+        elif isinstance(stmt, ast.Raise):
+            # nearest enclosing try WITH handlers: a handler-less frame
+            # (try/finally) must not shadow an outer except
+            handlers = next((hs for hs in reversed(tries) if hs), None)
+            if handlers:
+                for h in handlers:
+                    self.succ[key].add(h)
+            elif finals:
+                self.succ[key].add(finals[-1])
+            else:
+                self.succ[key].add(EXIT)
+        elif isinstance(stmt, ast.Try):
+            final_entry = (self._seq(stmt.finalbody, follow, loops, tries,
+                                     finals)
+                           if stmt.finalbody else follow)
+            inner_finals = (finals + [final_entry] if stmt.finalbody
+                            else finals)
+            handler_entries = [self._seq(h.body, final_entry, loops, tries,
+                                         inner_finals)
+                               for h in stmt.handlers]
+            after_body = (self._seq(stmt.orelse, final_entry, loops, tries,
+                                    inner_finals)
+                          if stmt.orelse else final_entry)
+            body = self._seq(stmt.body, after_body, loops,
+                             tries + [handler_entries], inner_finals)
+            self.succ[key].add(body)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.succ[key].add(self._seq(stmt.body, follow, loops, tries,
+                                         finals))
+        elif isinstance(stmt, ast.Match):
+            matched = False
+            for case in stmt.cases:
+                self.succ[key].add(self._seq(case.body, follow, loops,
+                                             tries, finals))
+                matched = True
+            if not matched:
+                self.succ[key].add(follow)
+            self.succ[key].add(follow)  # no case may match
+        else:
+            self.succ[key].add(follow)
+        return key
+
+    # -- queries ------------------------------------------------------------
+    def reachable(self, start: object,
+                  blocked: Optional[Set[object]] = None) -> Set[object]:
+        """Keys reachable from ``start`` (exclusive) without passing
+        through a ``blocked`` node."""
+        blocked = blocked or set()
+        seen: Set[object] = set()
+        stack = [s for s in self.succ.get(start, ()) if s not in blocked]
+        while stack:
+            node = stack.pop()
+            if node in seen:
+                continue
+            seen.add(node)
+            if node in blocked:
+                continue
+            stack.extend(self.succ.get(node, ()))
+        return seen
+
+
+# ------------------------------------------------------------ guarded walk
+
+@dataclasses.dataclass
+class GuardFrame:
+    """One enclosing guard on the walk: the guarding statement and the
+    taint (None for uniform guards) of its test."""
+
+    stmt: ast.stmt
+    taint: Optional[Taint]
+
+
+def guarded_statements(fn: ast.AST, taint_of) -> Iterator[
+        Tuple[ast.stmt, List[GuardFrame], List[ast.stmt]]]:
+    """Yield ``(stmt, guard_stack, loop_stack)`` for every own statement.
+
+    ``taint_of(expr)`` evaluates guard tests; ``guard_stack`` carries every
+    enclosing ``if``/``while`` frame (tainted or not, innermost last) plus
+    synthetic frames for rank-local I/O exception handlers; ``loop_stack``
+    is the enclosing ``for``/``while`` statements.
+    """
+
+    def walk(stmts, guards, loops):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield stmt, guards, loops
+            if isinstance(stmt, ast.If):
+                frame = GuardFrame(stmt, taint_of(stmt.test))
+                yield from walk(stmt.body, guards + [frame], loops)
+                yield from walk(stmt.orelse, guards + [frame], loops)
+            elif isinstance(stmt, ast.While):
+                frame = GuardFrame(stmt, taint_of(stmt.test))
+                yield from walk(stmt.body, guards + [frame], loops + [stmt])
+                yield from walk(stmt.orelse, guards, loops)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from walk(stmt.body, guards, loops + [stmt])
+                yield from walk(stmt.orelse, guards, loops)
+            elif isinstance(stmt, ast.Try):
+                yield from walk(stmt.body, guards, loops)
+                for h in stmt.handlers:
+                    frame = _handler_frame(stmt, h)
+                    hg = guards + [frame] if frame else guards
+                    yield from walk(h.body, hg, loops)
+                yield from walk(stmt.orelse, guards, loops)
+                yield from walk(stmt.finalbody, guards, loops)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from walk(stmt.body, guards, loops)
+            elif isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    yield from walk(case.body, guards, loops)
+
+    yield from walk(fn.body, [], [])
+
+
+def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    elts = t.elts if isinstance(t, ast.Tuple) else ([] if t is None else [t])
+    out = []
+    for e in elts:
+        path = analysis.dotted(e)
+        if path:
+            out.append(path.rsplit(".", 1)[-1])
+    return out
+
+
+def _handler_frame(try_stmt: ast.Try,
+                   handler: ast.ExceptHandler) -> Optional[GuardFrame]:
+    """A synthetic rank-taint frame for rank-local I/O exception handlers."""
+    names = _handler_names(handler)
+    hits = [n for n in names if n in IO_EXCEPTIONS]
+    if hits:
+        return GuardFrame(try_stmt, Taint(
+            "rank", f"inside a rank-local I/O handler (except {hits[0]})"))
+    return GuardFrame(try_stmt, None)
+
+
+# ------------------------------------------------------------- the engine
+
+class Dataflow:
+    """All cross-function facts the FX007-FX009 rules consume, built once
+    per :class:`~fleetx_tpu.lint.core.Project` and cached on it."""
+
+    def __init__(self, project):
+        self.project = project
+        self.functions: Dict[int, FuncInfo] = {}
+        self._local_defs: Dict[str, Dict[str, FuncInfo]] = {}
+        self._methods: Dict[Tuple[str, str, str], FuncInfo] = {}
+        self._by_global: Dict[str, FuncInfo] = {}
+        self._reexports: Dict[str, str] = {}
+        self._taints: Dict[int, Dict[str, Taint]] = {}
+        self._cfgs: Dict[int, CFG] = {}
+        self._returns_rank: Dict[int, Optional[str]] = {}
+        self.collective_chain: Dict[int, List[str]] = {}
+        self._collect()
+        self._summarize()
+
+    # -- collection ---------------------------------------------------------
+    def _module_dotted(self, relpath: str) -> str:
+        dotted = relpath[:-3].replace("/", ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[:-len(".__init__")]
+        return dotted
+
+    def _iter_sources(self):
+        """(relpath, tree, aliases, in_scope) for scope + context modules."""
+        from fleetx_tpu.lint.core import iter_context_files
+
+        seen = set()
+        for m in self.project.modules:
+            seen.add(m.relpath)
+            yield m.relpath, m.tree, analysis.module_aliases(m), True
+        for f in iter_context_files(self.project.root):
+            rel = self.project.relpath(f)
+            if rel in seen:
+                continue
+            seen.add(rel)
+            try:
+                tree = ast.parse(f.read_text(encoding="utf-8"))
+            except (SyntaxError, OSError, UnicodeDecodeError, ValueError):
+                continue
+            yield rel, tree, analysis.import_aliases(tree), False
+
+    def _collect(self) -> None:
+        for relpath, tree, aliases, in_scope in self._iter_sources():
+            dotted = self._module_dotted(relpath)
+            local: Dict[str, FuncInfo] = {}
+            self._local_defs[relpath] = local
+
+            def visit(node, cls, prefix):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        qual = f"{relpath}::{prefix}{child.name}"
+                        info = FuncInfo(qual, relpath, child, aliases,
+                                        cls=cls, in_scope=in_scope)
+                        self.functions[id(child)] = info
+                        local[child.name] = info
+                        if cls is None and not prefix:
+                            self._by_global[f"{dotted}.{child.name}"] = info
+                        if cls is not None:
+                            self._methods[(relpath, cls, child.name)] = info
+                        visit(child, cls, f"{prefix}{child.name}.")
+                    elif isinstance(child, ast.ClassDef):
+                        visit(child, child.name, f"{prefix}{child.name}.")
+                    else:
+                        visit(child, cls, prefix)
+
+            visit(tree, None, "")
+            # re-exports: `from x import f` at module top level makes
+            # `<this module>.f` an alias for `x.f`
+            for node in tree.body:
+                if isinstance(node, ast.ImportFrom) and node.module \
+                        and not node.level:
+                    for a in node.names:
+                        self._reexports[f"{dotted}.{a.asname or a.name}"] = \
+                            f"{node.module}.{a.name}"
+
+    def _deref(self, dotted: Optional[str]) -> Optional[FuncInfo]:
+        for _ in range(6):  # bounded re-export chase
+            if dotted is None:
+                return None
+            hit = self._by_global.get(dotted)
+            if hit is not None:
+                return hit
+            nxt = self._reexports.get(dotted)
+            if nxt == dotted:
+                return None
+            dotted = nxt
+        return None
+
+    # -- call resolution ----------------------------------------------------
+    def resolve_call(self, call: ast.Call,
+                     finfo: FuncInfo) -> Optional[FuncInfo]:
+        """The project function a call resolves to, through local scope,
+        ``self.``-method dispatch and the module's imports — or None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = self._local_defs.get(finfo.relpath, {}).get(func.id)
+            if local is not None:
+                return local
+            return self._deref(finfo.aliases.get(func.id))
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id in ("self", "cls") and finfo.cls:
+                return self._methods.get(
+                    (finfo.relpath, finfo.cls, func.attr))
+            return self._deref(analysis.resolve(func, finfo.aliases))
+        return None
+
+    # -- collective summaries ----------------------------------------------
+    def direct_collective(self, call: ast.Call,
+                          aliases: dict) -> Optional[str]:
+        """Why this call IS a gang collective, or None."""
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in COORD_METHODS:
+            return f"gang primitive '.{func.attr}()'"
+        resolved = analysis.resolve(func, aliases)
+        if resolved in LAX_COLLECTIVES:
+            return f"device collective '{resolved}'"
+        if resolved and resolved.startswith(MULTIHOST_PREFIX):
+            return f"multihost rendezvous '{resolved}'"
+        return None
+
+    def _own_calls(self, fn: ast.AST) -> Iterator[ast.Call]:
+        for stmt in analysis.own_statements(fn):
+            for expr in analysis.statement_exprs(stmt):
+                for node in analysis.walk_exprs(expr):
+                    if isinstance(node, ast.Call):
+                        yield node
+
+    def _summarize(self) -> None:
+        """Fixpoints: may-perform-collective chains + rank-local returns."""
+        edges: Dict[int, Set[int]] = {}
+        for fid, info in self.functions.items():
+            callees: Set[int] = set()
+            for call in self._own_calls(info.node):
+                desc = self.direct_collective(call, info.aliases)
+                if desc and fid not in self.collective_chain:
+                    self.collective_chain[fid] = [desc]
+                target = self.resolve_call(call, info)
+                if target is not None:
+                    callees.add(id(target.node))
+            edges[fid] = callees
+        changed = True
+        while changed:
+            changed = False
+            for fid, callees in edges.items():
+                if fid in self.collective_chain:
+                    continue
+                for cid in callees:
+                    chain = self.collective_chain.get(cid)
+                    if chain is None:
+                        continue
+                    name = self.functions[cid].node.name
+                    new = [f"{name}()"] + chain
+                    if len(new) > 6:
+                        # cap the DISPLAYED chain only — propagation must
+                        # never stop, or deep engine call chains (fit ->
+                        # rollback -> save -> commit vote is already 6)
+                        # would silently fall out of coverage
+                        new = new[:2] + ["..."] + new[-1:]
+                    self.collective_chain[fid] = new
+                    changed = True
+                    break
+        # rank-local return summaries, to fixpoint: each pass may add
+        # summaries that retaint other functions' environments, so the
+        # per-function taint cache is dropped between passes (and after
+        # the last one — rule-time queries must see the final summaries)
+        for _ in range(3):
+            changed = False
+            for fid, info in self.functions.items():
+                if self._returns_rank.get(fid):
+                    continue
+                reason = self._returns_rank_local(info)
+                if reason and self._returns_rank.get(fid) != reason:
+                    self._returns_rank[fid] = reason
+                    changed = True
+            self._taints.clear()
+            if not changed:
+                break
+
+    def collective_of(self, fn: ast.AST) -> Optional[str]:
+        """Human chain for a may-collective function ('save() -> ...')."""
+        chain = self.collective_chain.get(id(fn))
+        if chain is None:
+            return None
+        return " -> ".join(chain)
+
+    def call_collective(self, call: ast.Call,
+                        finfo: FuncInfo) -> Optional[str]:
+        """Why evaluating this call (transitively) runs a collective."""
+        direct = self.direct_collective(call, finfo.aliases)
+        if direct:
+            return direct
+        target = self.resolve_call(call, finfo)
+        if target is not None:
+            chain = self.collective_of(target.node)
+            if chain:
+                return f"'{ast.unparse(call.func)}()' -> {chain}"
+        return None
+
+    def _returns_rank_local(self, info: FuncInfo) -> Optional[str]:
+        env = self.taints(info)
+        for stmt in analysis.own_statements(info.node):
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                t = self.expr_taint(stmt.value, env, info)
+                if t is not None:
+                    return (f"'{info.node.name}()' returns a rank-local "
+                            f"value ({t.reason})")
+        return None
+
+    # -- taint --------------------------------------------------------------
+    def expr_taint(self, node: ast.AST, env: Dict[str, Taint],
+                   finfo: FuncInfo) -> Optional[Taint]:
+        """May-taint of one expression under the name environment ``env``."""
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in RANK_SOURCE_ATTRS:
+                return Taint("rank", f"reads rank-local '.{node.attr}'")
+            if node.attr in UNIFORM_ATTRS:
+                return None
+            return self.expr_taint(node.value, env, finfo)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node, env, finfo)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            left = self.expr_taint(node.left, env, finfo)
+            if left is not None:
+                counter = ast.unparse(node.left)
+                return Taint("mod", f"modulo over rank-local counter "
+                                    f"'{counter}' ({left.reason})")
+            return self.expr_taint(node.right, env, finfo)
+        if isinstance(node, ast.Subscript):
+            if self._is_rank_env_subscript(node, finfo):
+                return Taint("rank", "rank-keyed environment lookup")
+            # fall through to the generic child walk
+        if isinstance(node, (ast.Constant, ast.Lambda)):
+            return None
+        out: Optional[Taint] = None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension, ast.keyword)):
+                t = self.expr_taint(child, env, finfo)
+                if t is not None:
+                    if t.kind == "mod":
+                        return t      # the specific diagnosis wins
+                    out = out or t
+        return out
+
+    def _is_rank_env_key(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Constant) and \
+            isinstance(node.value, str) and node.value in RANK_ENV_KEYS
+
+    def _is_rank_env_subscript(self, node: ast.Subscript,
+                               finfo: FuncInfo) -> bool:
+        target = analysis.resolve(node.value, finfo.aliases)
+        return target == "os.environ" and self._is_rank_env_key(node.slice)
+
+    def _call_taint(self, call: ast.Call, env: Dict[str, Taint],
+                    finfo: FuncInfo) -> Optional[Taint]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in SANITIZER_METHODS:
+                return None   # an agreement result is gang-uniform
+            if func.attr in READBACK_ATTRS and not call.args:
+                return Taint("rank", f"device readback '.{func.attr}()' "
+                                     "(per-rank under the in-step skip)")
+        resolved = analysis.resolve(func, finfo.aliases)
+        if resolved in RANK_SOURCE_CALLS:
+            return Taint("rank", f"'{resolved}()' is rank-local")
+        if resolved in READBACK_CALLS:
+            return Taint("rank", f"'{resolved}' reads a per-rank device "
+                                 "value (diverges under the in-step skip)")
+        if resolved in ("os.getenv", "os.environ.get") and call.args and \
+                self._is_rank_env_key(call.args[0]):
+            return Taint("rank", "rank-keyed environment lookup")
+        if isinstance(func, ast.Name) and func.id == "next":
+            return Taint("rank", "per-rank stream read (next())")
+        target = self.resolve_call(call, finfo)
+        if target is not None:
+            reason = self._returns_rank.get(id(target.node))
+            if reason:
+                return Taint("rank", reason)
+        parts = [*call.args, *(kw.value for kw in call.keywords)]
+        if isinstance(func, ast.Attribute):
+            parts.append(func.value)
+        out: Optional[Taint] = None
+        for p in parts:
+            t = self.expr_taint(p, env, finfo)
+            if t is not None:
+                if t.kind == "mod":
+                    return t
+                out = out or t
+        return out
+
+    def taints(self, finfo: FuncInfo) -> Dict[str, Taint]:
+        """Fixpoint of rank-tainted names inside one function."""
+        fid = id(finfo.node)
+        cached = self._taints.get(fid)
+        if cached is not None:
+            return cached
+        env: Dict[str, Taint] = {}
+        self._taints[fid] = env   # pre-publish: recursion-safe
+        for p in (*finfo.node.args.posonlyargs, *finfo.node.args.args,
+                  *finfo.node.args.kwonlyargs):
+            if p.arg in ("rank", "process_index"):
+                env[p.arg] = Taint("rank", f"parameter '{p.arg}' carries "
+                                           "the process identity")
+        for _ in range(20):   # bounded fixpoint
+            if not self._taint_pass(finfo, env):
+                break
+        return env
+
+    def _taint_pass(self, finfo: FuncInfo, env: Dict[str, Taint]) -> bool:
+        changed = False
+
+        def bind(target, taint):
+            nonlocal changed
+            for name in analysis.target_names(target):
+                if name not in env:
+                    env[name] = taint
+                    changed = True
+
+        def guard_taint(guards):
+            for g in reversed(guards):
+                if g.taint is not None:
+                    return g.taint
+            return None
+
+        for stmt, guards, _loops in guarded_statements(
+                finfo.node, lambda e: self.expr_taint(e, env, finfo)):
+            value = None
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, ast.AugAssign):
+                targets, value = [stmt.target], stmt.value
+                gt = guard_taint(guards)
+                if gt is not None and isinstance(stmt.target, ast.Name):
+                    # implicit flow, counters only: an increment that only
+                    # SOME ranks execute makes the counter itself rank-local
+                    # (the exact in-step-skip desync shape)
+                    bind(stmt.target, Taint(
+                        "rank", f"counter '{stmt.target.id}' advanced under "
+                                f"a rank-divergent guard ({gt.reason})"))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                targets, value = [stmt.target], stmt.iter
+            elif isinstance(stmt, ast.Try):
+                for h in stmt.handlers:
+                    if h.name and any(n in IO_EXCEPTIONS
+                                      for n in _handler_names(h)):
+                        if h.name not in env:
+                            env[h.name] = Taint(
+                                "rank", "caught a rank-local I/O exception")
+                            changed = True
+            if value is not None and targets:
+                t = self.expr_taint(value, env, finfo)
+                if t is not None:
+                    for target in targets:
+                        bind(target, t)
+        return changed
+
+    # -- CFG ---------------------------------------------------------------
+    def cfg(self, finfo: FuncInfo) -> CFG:
+        """The function's control-flow graph (built once, cached)."""
+        fid = id(finfo.node)
+        got = self._cfgs.get(fid)
+        if got is None:
+            got = self._cfgs[fid] = CFG(finfo.node)
+        return got
+
+    # -- scope helpers ------------------------------------------------------
+    def scope_functions(self) -> Iterator[FuncInfo]:
+        """Functions defined in the linted modules (findings surface here;
+        context-only modules feed the call graph silently)."""
+        for info in self.functions.values():
+            if info.in_scope:
+                yield info
+
+
+def get_dataflow(project) -> Dataflow:
+    """The project's dataflow engine, built once and cached (rules share
+    the call graph, taint environments and CFGs)."""
+    cached = getattr(project, "_lint_dataflow", None)
+    if cached is None:
+        cached = project._lint_dataflow = Dataflow(project)
+    return cached

@@ -1,14 +1,16 @@
-"""Text and JSON rendering of a :class:`LintResult`.
+"""Text, JSON and SARIF rendering of a :class:`LintResult`.
 
 Text mimics the compiler convention (``path:line:col: CODE[rule] message``)
 so editors and CI annotations pick locations up; JSON follows the
 ``tools/metrics_report.py --json`` spirit — a single machine-readable object
-a gating script can consume without scraping stdout.
+a gating script can consume without scraping stdout; SARIF 2.1.0 is the
+interchange format CI forges ingest to annotate findings inline on the
+diff (``tools/lint.py --sarif``).
 """
 
 from __future__ import annotations
 
-from fleetx_tpu.lint.core import LintResult
+from fleetx_tpu.lint.core import LintResult, all_rules
 
 
 def render_text(result: LintResult, verbose: bool = False) -> str:
@@ -47,4 +49,60 @@ def render_json(result: LintResult) -> dict:
         "suppressed": [f.to_dict() for f in result.suppressed],
         "baselined": [f.to_dict() for f in result.baselined],
         "clean": result.clean,
+    }
+
+
+def render_sarif(result: LintResult) -> dict:
+    """SARIF 2.1.0 log: one run, one result per active finding.
+
+    ``partialFingerprints`` carries the content-based fingerprint the
+    baseline machinery already computes, so a SARIF consumer's "new since
+    last scan" diffing agrees with ``tools/lint_baseline.json``.  Only
+    active findings are emitted — suppressed/baselined ones are resolved
+    by definition and would re-open as annotations otherwise.
+    """
+    registered = {r.name: r for r in all_rules().values()}
+    rule_names = [n for n in result.rules if n in registered]
+    rule_index = {n: i for i, n in enumerate(rule_names)}
+    sarif_rules = []
+    for name in rule_names:
+        rule = registered[name]
+        sarif_rules.append({
+            "id": rule.code,
+            "name": rule.name,
+            "shortDescription": {"text": rule.description or rule.name},
+            "helpUri": "docs/static_analysis.md",
+            "properties": {"category": rule.category},
+        })
+    results = []
+    for f in result.findings:
+        entry = {
+            "ruleId": f.code,
+            "level": "error",   # the gate treats any finding as failing
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": max(f.col + 1, 1)},
+                },
+            }],
+        }
+        if f.fingerprint:
+            entry["partialFingerprints"] = {"fleetxLint/v1": f.fingerprint}
+        if f.rule in rule_index:
+            entry["ruleIndex"] = rule_index[f.rule]
+        results.append(entry)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "fleetx-lint",
+                                "informationUri":
+                                    "docs/static_analysis.md",
+                                "rules": sarif_rules}},
+            "columnKind": "unicodeCodePoints",
+            "results": results,
+        }],
     }

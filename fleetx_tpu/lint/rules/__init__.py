@@ -1,10 +1,12 @@
 """Rule modules — importing this package registers every rule."""
 
 from fleetx_tpu.lint.rules import (  # noqa: F401
+    collectives,
     config_keys,
     docstrings,
     donation,
     prng,
     pspec,
+    retrace,
     tracing,
 )

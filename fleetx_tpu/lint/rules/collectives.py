@@ -1,0 +1,345 @@
+"""Gang-collective lockstep rules (FX007-FX009) over the dataflow engine.
+
+The contract these rules enforce is the one ``resilience/coordination.py``
+states and docs/resilience.md's collective-decision table catalogues: every
+rank must invoke the same agreement primitives in the same order, so ANY
+control flow that reaches a collective on some ranks but not others wedges
+the whole gang until ``CoordinationTimeout``.  The PR 6-8 review history is
+one instance of this class after another — a unilateral stream-dry loop
+exit, a step-keyed save trigger under the in-step skip, an early raise
+between the rollback barriers — and each named bug is now a regression
+fixture in ``tests/test_zz_lint_v2.py``.
+
+- **FX007** ``collective-under-rank-guard`` — a gang primitive (or a call
+  that transitively performs one, via the project call graph) lexically
+  dominated by an ``if``/``while`` whose test is rank-tainted, or inside a
+  rank-local I/O exception handler.
+- **FX008** ``unmatched-agreement-pairing`` — two patterns: (a) a paired
+  protocol (``X_enter``/``X_exit`` and friends, see
+  :data:`PAIRED_SUFFIXES`/:data:`EXTRA_PAIRS`) whose CFG admits a
+  rank-divergent escape path between the pair; (b) a rank-tainted early
+  ``return``/``raise``/``break``/``continue`` that skips collectives its
+  peers still execute.
+- **FX009** ``step-keyed-gang-trigger`` — the FX007 shape where the guard
+  is specifically a modulo over a rank-local counter (``step %
+  save_steps``-style): the exact PR 6/7 desync, reported separately so the
+  fix ("key on the lockstep iteration counter") is in the message.
+  Lockstep counters (unconditionally advanced, e.g. ``vote_round``) do not
+  taint, so vote-round-keyed triggers pass.
+
+Divergence that is provably pre-agreed (single-process branches, arms that
+match the same rendezvous either way) is silenced inline with
+``# fleetx: noqa[rule] -- reason``, never baselined.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, List, Optional
+
+from fleetx_tpu.lint import analysis, dataflow
+from fleetx_tpu.lint.core import Finding, Project, Rule, register
+
+#: paired-protocol registry, suffix convention: an agreement named
+#: ``<base><opener>`` must be matched by ``<base><closer>`` on every path
+#: to function exit (docs/static_analysis.md "Declaring a paired primitive")
+PAIRED_SUFFIXES = (
+    ("_enter", "_exit"),
+    ("_begin", "_end"),
+    ("_prepare", "_commit"),
+)
+
+#: explicit pairs for protocols that don't follow the suffix convention
+#: (opener agreement name -> required closer agreement name)
+EXTRA_PAIRS: dict = {}
+
+
+def _closer_for(name: str) -> Optional[str]:
+    """The agreement name that must close ``name``, or None."""
+    if name in EXTRA_PAIRS:
+        return EXTRA_PAIRS[name]
+    for opener, closer in PAIRED_SUFFIXES:
+        if name.endswith(opener):
+            return name[: -len(opener)] + closer
+    return None
+
+
+@dataclasses.dataclass
+class _CollectiveSite:
+    """One (transitively) collective call and its control context."""
+
+    stmt: ast.stmt
+    call: ast.Call
+    desc: str
+    guard: Optional[dataflow.GuardFrame]   # innermost tainted guard
+    loops: List[ast.stmt]
+    agreement: Optional[str] = None        # literal name arg, if any
+
+
+@dataclasses.dataclass
+class _ExitSite:
+    """One return/raise/break/continue and its control context."""
+
+    stmt: ast.stmt
+    guard: Optional[dataflow.GuardFrame]
+    loops: List[ast.stmt]
+
+
+@dataclasses.dataclass
+class _FunctionFacts:
+    info: dataflow.FuncInfo
+    collectives: List[_CollectiveSite]
+    exits: List[_ExitSite]
+
+
+def _innermost_tainted(guards) -> Optional[dataflow.GuardFrame]:
+    for g in reversed(guards):
+        if g.taint is not None:
+            return g
+    return None
+
+
+def _agreement_name(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def function_facts(project: Project) -> List[_FunctionFacts]:
+    """Collective/exit sites with guard context for every in-scope
+    function, computed once per project and shared by FX007-FX009."""
+    cached = getattr(project, "_lint_gang_facts", None)
+    if cached is not None:
+        return cached
+    df = dataflow.get_dataflow(project)
+    out: List[_FunctionFacts] = []
+    for info in df.scope_functions():
+        env = df.taints(info)
+        collectives: List[_CollectiveSite] = []
+        exits: List[_ExitSite] = []
+        for stmt, guards, loops in dataflow.guarded_statements(
+                info.node, lambda e: df.expr_taint(e, env, info)):
+            if isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                                 ast.Continue)):
+                exits.append(_ExitSite(stmt, _innermost_tainted(guards),
+                                       list(loops)))
+            for expr in analysis.statement_exprs(stmt):
+                for node in analysis.walk_exprs(expr):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    desc = df.call_collective(node, info)
+                    if desc is None:
+                        continue
+                    collectives.append(_CollectiveSite(
+                        stmt, node, desc, _innermost_tainted(guards),
+                        list(loops), agreement=_agreement_name(node)))
+        out.append(_FunctionFacts(info, collectives, exits))
+    project._lint_gang_facts = out
+    return out
+
+
+def _arm_ids(guard_stmt: ast.stmt, exit_stmt: ast.stmt) -> set:
+    """Node ids of the guard arm (if-body/orelse/except-body) that contains
+    ``exit_stmt`` — the code the exiting rank itself runs."""
+    arms: List[list] = []
+    if isinstance(guard_stmt, (ast.If, ast.While)):
+        arms = [guard_stmt.body, guard_stmt.orelse]
+    elif isinstance(guard_stmt, ast.Try):
+        arms = [h.body for h in guard_stmt.handlers]
+    for arm in arms:
+        ids = {id(n) for s in arm for n in ast.walk(s)}
+        if id(exit_stmt) in ids:
+            return ids
+    return set()
+
+
+def _guard_text(guard: dataflow.GuardFrame) -> str:
+    stmt = guard.stmt
+    if isinstance(stmt, (ast.If, ast.While)):
+        try:
+            return f"'{ast.unparse(stmt.test)}' (line {stmt.lineno})"
+        except Exception:  # noqa: BLE001 — unparse is best-effort detail
+            return f"the guard at line {stmt.lineno}"
+    return f"the handler at line {stmt.lineno}"
+
+
+@register
+class CollectiveUnderRankGuard(Rule):
+    """Gang collectives reachable only under rank-divergent control flow."""
+
+    name = "collective-under-rank-guard"
+    code = "FX007"
+    scope = "project"
+    description = ("gang collective (coordinator primitive / ckpt commit / "
+                   "lax collective) dominated by a rank-divergent branch — "
+                   "ranks that skip it wedge the gang")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for facts in function_facts(project):
+            for site in facts.collectives:
+                if site.guard is None or site.guard.taint.kind != "rank":
+                    continue
+                out.append(self.finding(
+                    facts.info.relpath, site.call.lineno,
+                    site.call.col_offset,
+                    f"{site.desc} runs only under {_guard_text(site.guard)}, "
+                    f"which is rank-divergent ({site.guard.taint.reason}) — "
+                    f"ranks that skip the call strand their peers until "
+                    f"CoordinationTimeout; agree on the condition first "
+                    f"(broadcast/any_flag) or hoist the collective out of "
+                    f"the guard"))
+        return out
+
+
+@register
+class UnmatchedAgreementPairing(Rule):
+    """Early exits that break a paired protocol or skip peers' collectives."""
+
+    name = "unmatched-agreement-pairing"
+    code = "FX008"
+    scope = "project"
+    description = ("a rank-divergent early return/raise/break escapes "
+                   "between paired agreement calls (X_enter without X_exit, "
+                   "vote without barrier) or out of a collective loop")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        df = dataflow.get_dataflow(project)
+        for facts in function_facts(project):
+            reported: set = set()
+            out.extend(self._check_pairs(df, facts, reported))
+            out.extend(self._check_exits(df, facts, reported))
+        return out
+
+    # -- pattern A: registered pairs + CFG escape enumeration ---------------
+    def _check_pairs(self, df, facts: _FunctionFacts,
+                     reported: set) -> Iterable[Finding]:
+        openers = [s for s in facts.collectives
+                   if s.agreement and _closer_for(s.agreement)]
+        if not openers:
+            return
+        cfg = df.cfg(facts.info)
+        exits_by_id = {id(e.stmt): e for e in facts.exits}
+        for opener in openers:
+            closer_name = _closer_for(opener.agreement)
+            closers = {id(s.stmt) for s in facts.collectives
+                       if s.agreement == closer_name}
+            if not closers:
+                yield self.finding(
+                    facts.info.relpath, opener.call.lineno,
+                    opener.call.col_offset,
+                    f"agreement '{opener.agreement}' opens a paired "
+                    f"protocol but no matching '{closer_name}' call exists "
+                    f"in this function — peers reaching the closer will "
+                    f"wedge (paired protocols must close in the function "
+                    f"that opens them)")
+                continue
+            reach = cfg.reachable(id(opener.stmt), blocked=closers)
+            if dataflow.EXIT not in reach:
+                continue
+            for key in reach:
+                site = exits_by_id.get(key)
+                if site is None or site.guard is None:
+                    continue
+                if dataflow.EXIT not in cfg.succ.get(key, ()):
+                    continue   # e.g. a raise absorbed by a local handler
+                if id(site.stmt) in reported:
+                    continue
+                reported.add(id(site.stmt))
+                kind = type(site.stmt).__name__.lower()
+                yield self.finding(
+                    facts.info.relpath, site.stmt.lineno,
+                    site.stmt.col_offset,
+                    f"this '{kind}' escapes between '{opener.agreement}' "
+                    f"(line {opener.call.lineno}) and its paired "
+                    f"'{closer_name}' under {_guard_text(site.guard)} "
+                    f"({site.guard.taint.reason}) — peers block in the "
+                    f"closing rendezvous; vote the failure through "
+                    f"any_flag/all_gather and exit uniformly")
+
+    # -- pattern B: rank-divergent exits that skip peers' collectives -------
+    def _check_exits(self, df, facts: _FunctionFacts,
+                     reported: set) -> Iterable[Finding]:
+        if not facts.collectives:
+            return
+        cfg = None
+        for site in facts.exits:
+            if site.guard is None or id(site.stmt) in reported:
+                continue
+            stmt = site.stmt
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                if not site.loops:
+                    continue
+                loop = site.loops[-1]
+                pending = [c for c in facts.collectives
+                           if loop in c.loops]
+                if not pending:
+                    continue
+                reported.add(id(stmt))
+                kind = "break" if isinstance(stmt, ast.Break) else "continue"
+                yield self.finding(
+                    facts.info.relpath, stmt.lineno, stmt.col_offset,
+                    f"rank-divergent '{kind}' ({site.guard.taint.reason}) "
+                    f"in a loop whose body issues {pending[0].desc} (line "
+                    f"{pending[0].call.lineno}) — peers still looping "
+                    f"wedge in their next rendezvous; make the exit a "
+                    f"gang decision (vote the flag via any_flag)")
+                continue
+            # return / raise: only when it actually leaves the function
+            if cfg is None:
+                cfg = df.cfg(facts.info)
+            if dataflow.EXIT not in cfg.succ.get(id(stmt), ()):
+                continue
+            # "what peers go on to run" = reachable from the guard MINUS
+            # the guard arm the exit itself sits on (a collective on the
+            # exiting rank's own path is FX007's business; counting it
+            # here would invert the diagnosis: `if rank == 0:
+            # barrier(); return` does not strand peers in that barrier)
+            own_arm = _arm_ids(site.guard.stmt, stmt)
+            reach = cfg.reachable(id(site.guard.stmt))
+            pending = [c for c in facts.collectives
+                       if id(c.stmt) in reach and c.stmt is not stmt
+                       and id(c.stmt) not in own_arm]
+            if not pending:
+                continue
+            reported.add(id(stmt))
+            kind = type(stmt).__name__.lower()
+            yield self.finding(
+                facts.info.relpath, stmt.lineno, stmt.col_offset,
+                f"rank-divergent '{kind}' ({site.guard.taint.reason}) "
+                f"exits while peers go on to {pending[0].desc} (line "
+                f"{pending[0].call.lineno}) — they wedge until "
+                f"CoordinationTimeout; agree on the exit first "
+                f"(any_flag/all_gather), then return/raise on every rank")
+
+
+@register
+class StepKeyedGangTrigger(Rule):
+    """Modulo-on-a-rank-local-counter guards around gang collectives."""
+
+    name = "step-keyed-gang-trigger"
+    code = "FX009"
+    scope = "project"
+    description = ("a '% save_steps'-style modulo over a rank-local step "
+                   "counter triggers a collective — counters skew under "
+                   "the in-step skip; key on a lockstep round counter")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for facts in function_facts(project):
+            for site in facts.collectives:
+                if site.guard is None or site.guard.taint.kind != "mod":
+                    continue
+                out.append(self.finding(
+                    facts.info.relpath, site.call.lineno,
+                    site.call.col_offset,
+                    f"{site.desc} is triggered by {_guard_text(site.guard)} "
+                    f"— {site.guard.taint.reason}; per-rank step counters "
+                    f"skew (fp16/guard in-step skip), so some ranks sit "
+                    f"out the rendezvous while peers wedge in it — key "
+                    f"the trigger on a lockstep iteration counter "
+                    f"(vote_round) instead"))
+        return out

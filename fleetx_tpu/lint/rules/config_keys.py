@@ -145,6 +145,7 @@ class DeadConfigKey(Rule):
     name = "dead-config-key"
     code = "FX006"
     scans_configs = True
+    scope = "project"
     description = ("YAML config key no code consumes / code reads a config "
                    "section no YAML provides")
 

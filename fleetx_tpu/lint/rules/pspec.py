@@ -46,6 +46,10 @@ class PSpecMeshMismatch(Rule):
     description = ("PartitionSpec axis literal not declared in "
                    "parallel/mesh.py MESH_AXES — fails at jit bind time")
 
+    def context_key(self, project: Project) -> str:
+        """Findings depend on the declared mesh axes, not just the file."""
+        return ",".join(project.mesh_axes())
+
     def check_module(self, module: SourceModule,
                      project: Project) -> Iterable[Finding]:
         aliases = analysis.module_aliases(module)

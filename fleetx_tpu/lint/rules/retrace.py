@@ -1,0 +1,266 @@
+"""FX010 retrace-hazard: jitted callables fed loop-varying shapes/statics.
+
+The serving runtime's core invariant is "two static-shape jitted programs
+that never retrace" (docs/serving.md) — today that is pinned only by the
+jit-cache-size assertions in ``tests/test_zz_serving.py``.  This rule moves
+the invariant into lint: a callable the module provably jits (decorated,
+or bound via ``x = jax.jit(fn, ...)``) that is invoked inside a Python
+loop with an argument whose SHAPE (or static value) varies across
+iterations compiles a fresh executable per distinct shape/value — the
+classic silent-slowdown where step N is fast and step N+1 stalls in XLA.
+
+Three shapes are flagged, each with a named fixture:
+
+1. a **static argument** (``static_argnums``/``static_argnames`` position)
+   whose expression involves a loop-varying name — one compile per value;
+2. a **sliced operand** whose slice length is not syntactically constant
+   and whose bounds involve a loop-varying name (``buf[:len(active)]``) —
+   one compile per length.  Constant-length windows (``x[p:p + K]`` with
+   the same base expression and a constant offset) pass: that is the
+   engine's chunked-prefill idiom;
+3. an **array constructor** (``np.zeros``/``jnp.ones``/...) whose shape
+   argument involves a loop-varying name.
+
+Loop-varying names are computed per loop by fixpoint: ``for`` targets,
+augmented-assignment targets, self-updates (``x = f(x)``), and anything
+assigned from them.  The analysis is intra-procedural and name-granular
+(attributes like ``self.pool_k`` are not tracked) — the documented
+trade-off of the whole linter.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, List, Optional, Set
+
+from fleetx_tpu.lint import analysis
+from fleetx_tpu.lint.core import Finding, Project, Rule, SourceModule, register
+
+#: resolved constructors whose first argument is a shape
+_SHAPE_CTORS = {
+    f"{mod}.{fn}"
+    for mod in ("numpy", "jax.numpy")
+    for fn in ("zeros", "ones", "full", "empty", "arange")
+}
+
+
+@dataclasses.dataclass
+class _JitBinding:
+    """One callable the module jits, with its static-argument metadata."""
+
+    params: List[str]            # positional param names ([] when unknown)
+    static_names: Set[str]       # static params by name
+    static_positions: Set[int]   # static params by call position
+
+    def static_at(self, index: int) -> bool:
+        """Is the call-site positional argument at ``index`` static?"""
+        if index in self.static_positions:
+            return True
+        return index < len(self.params) and \
+            self.params[index] in self.static_names
+
+
+def _static_meta(call: ast.Call, params: List[str]) -> _JitBinding:
+    """Decode static_argnums/static_argnames off a ``jax.jit(...)`` call."""
+    names: Set[str] = set()
+    positions: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            positions.update(analysis._literal_ints(kw.value))
+        elif kw.arg == "static_argnames":
+            names.update(analysis._literal_strs(kw.value))
+    return _JitBinding(params=params, static_names=names,
+                       static_positions=positions)
+
+
+def jit_bindings(module: SourceModule) -> dict:
+    """Callable-expression string -> :class:`_JitBinding` for everything
+    this module jits: decorated defs and ``target = jax.jit(fn, ...)``
+    assignments (including ``self._step = ...``)."""
+    aliases = analysis.module_aliases(module)
+    defs_by_name = {
+        n.name: n for n in ast.walk(module.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    bindings: dict = {}
+    for tf in analysis.module_traced(module):
+        if tf.via != "decorator":
+            continue
+        params = analysis._positional_params(tf.node)
+        bindings[tf.node.name] = _JitBinding(
+            params=params, static_names=set(tf.static_params),
+            static_positions=set())
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and analysis.resolve(node.value.func, aliases)
+                in analysis.JIT_NAMES and len(node.targets) == 1):
+            continue
+        call = node.value
+        params: List[str] = []
+        if call.args:
+            head = call.args[0]
+            if isinstance(head, ast.Lambda):
+                params = analysis._positional_params(head)
+            elif isinstance(head, ast.Name) and head.id in defs_by_name:
+                params = analysis._positional_params(defs_by_name[head.id])
+        try:
+            key = ast.unparse(node.targets[0])
+        except Exception:  # noqa: BLE001 — exotic target, skip
+            continue
+        bindings[key] = _static_meta(call, params)
+    return bindings
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _loop_varying(loop: ast.stmt) -> Set[str]:
+    """Names whose value varies across iterations of ``loop``."""
+    varying: Set[str] = set()
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        varying.update(analysis.target_names(loop.target))
+    stmts = list(analysis.own_statements_of_body(loop.body))
+    for stmt in stmts:   # seeds: self-updates + augmented assignments
+        if isinstance(stmt, ast.AugAssign):
+            varying.update(analysis.target_names(stmt.target))
+        elif isinstance(stmt, ast.Assign):
+            targets = {n for t in stmt.targets
+                       for n in analysis.target_names(t)}
+            if targets & _names_in(stmt.value):
+                varying.update(targets)
+    changed = True
+    while changed:
+        changed = False
+        for stmt in stmts:
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                targets, value = [stmt.target], stmt.iter
+            if value is None or not (_names_in(value) & varying):
+                continue
+            for t in targets:
+                for name in analysis.target_names(t):
+                    if name not in varying:
+                        varying.add(name)
+                        changed = True
+    return varying
+
+
+def _const_length_slice(sl: ast.Slice) -> bool:
+    """True when the slice length is syntactically constant (both bounds
+    constant, or ``x : x + K`` / ``x : x - K`` over the same base)."""
+    lower, upper = sl.lower, sl.upper
+    if sl.step is not None:
+        return False
+    consts = [b is None or isinstance(b, ast.Constant)
+              for b in (lower, upper)]
+    if all(consts):
+        return True
+    if lower is not None and upper is not None and \
+            isinstance(upper, ast.BinOp) and \
+            isinstance(upper.op, (ast.Add, ast.Sub)) and \
+            isinstance(upper.right, ast.Constant):
+        try:
+            return ast.unparse(upper.left) == ast.unparse(lower)
+        except Exception:  # noqa: BLE001 — unparse is best-effort
+            return False
+    return False
+
+
+@register
+class RetraceHazard(Rule):
+    """Jit re-compiles per iteration from varying shapes/static values."""
+
+    name = "retrace-hazard"
+    code = "FX010"
+    description = ("a jitted callable is invoked in a loop with a "
+                   "Python-varying shape or static argument — one XLA "
+                   "compile per distinct value; pin the shape (pad/mask) "
+                   "like the serving runtime's static-shape programs")
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        bindings = jit_bindings(module)
+        if not bindings:
+            return ()
+        aliases = analysis.module_aliases(module)
+        out: List[Finding] = []
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            varying = _loop_varying(loop)
+            if not varying:
+                continue
+            for stmt in analysis.own_statements_of_body(loop.body):
+                for expr in analysis.statement_exprs(stmt):
+                    for node in analysis.walk_exprs(expr):
+                        if isinstance(node, ast.Call):
+                            out.extend(self._check_call(
+                                node, bindings, varying, aliases,
+                                module.relpath))
+        return out
+
+    def _check_call(self, call: ast.Call, bindings: dict, varying: Set[str],
+                    aliases: dict, relpath: str) -> Iterable[Finding]:
+        try:
+            key = ast.unparse(call.func)
+        except Exception:  # noqa: BLE001 — exotic callee
+            return
+        binding = bindings.get(key)
+        if binding is None:
+            return
+        for idx, arg in enumerate(call.args):
+            yield from self._check_arg(
+                call, key, arg, binding.static_at(idx), varying, aliases,
+                relpath)
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            yield from self._check_arg(
+                call, key, kw.value, kw.arg in binding.static_names,
+                varying, aliases, relpath)
+
+    def _check_arg(self, call: ast.Call, key: str, arg: ast.AST,
+                   is_static: bool, varying: Set[str], aliases: dict,
+                   relpath: str) -> Iterable[Finding]:
+        names = _names_in(arg) & varying
+        if not names:
+            return
+        what = sorted(names)[0]
+        if is_static:
+            yield self.finding(
+                relpath, call.lineno, call.col_offset,
+                f"static argument '{ast.unparse(arg)}' of jitted '{key}' "
+                f"involves loop-varying '{what}' — jax compiles a fresh "
+                f"executable per distinct static value; make it a traced "
+                f"array argument or hoist it out of the loop")
+            return
+        for node in ast.walk(arg) if not isinstance(arg, ast.Subscript) \
+                else [arg]:
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Slice) and \
+                    not _const_length_slice(node.slice) and \
+                    (_names_in(node.slice) & varying):
+                yield self.finding(
+                    relpath, call.lineno, call.col_offset,
+                    f"operand '{ast.unparse(node)}' of jitted '{key}' is a "
+                    f"slice whose length varies with loop-local '{what}' — "
+                    f"every new length retraces; pad to a static shape and "
+                    f"mask (the serving runtime's static-batch idiom)")
+                return
+        if isinstance(arg, ast.Call):
+            ctor = analysis.resolve(arg.func, aliases)
+            if ctor in _SHAPE_CTORS and arg.args and \
+                    (_names_in(arg.args[0]) & varying):
+                yield self.finding(
+                    relpath, call.lineno, call.col_offset,
+                    f"operand '{ast.unparse(arg)}' of jitted '{key}' is "
+                    f"constructed with a shape that varies with "
+                    f"loop-local '{what}' — one retrace per shape; "
+                    f"allocate at the static maximum and mask")

@@ -36,6 +36,8 @@ def test_registry_has_all_rules():
     for name in ("host-sync-in-traced-code", "donated-buffer-reuse",
                  "prng-key-reuse", "pspec-mesh-mismatch",
                  "traced-python-branch", "dead-config-key",
+                 "collective-under-rank-guard", "unmatched-agreement-pairing",
+                 "step-keyed-gang-trigger", "retrace-hazard",
                  "docstring-missing", "docstring-empty"):
         assert name in rules, name
     codes = [r.code for r in rules.values()]
@@ -659,7 +661,9 @@ def test_write_baseline_refuses_filtered_run(tmp_path):
 # ---------------------------------------------------------- whole-repo gate
 
 def test_whole_repo_lint_is_clean():
-    """The CI contract: `python tools/lint.py` exits 0 on the tree."""
+    """The CI contract: `python tools/lint.py` exits 0 on the tree with
+    EVERY rule enabled — the v2 gang-lockstep rules included — and with
+    zero baseline entries (true positives are fixed, not accepted)."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "lint.py"),
          "--json", "-"],
@@ -668,7 +672,13 @@ def test_whole_repo_lint_is_clean():
     # stdout carries the JSON payload then the text summary
     payload = json.loads(proc.stdout[:proc.stdout.rindex("}") + 1])
     assert payload["clean"] is True
-    assert len(payload["rules"]) >= 8
+    assert len(payload["rules"]) >= 12
+    for name in ("collective-under-rank-guard", "unmatched-agreement-pairing",
+                 "step-keyed-gang-trigger", "retrace-hazard"):
+        assert name in payload["rules"], name
+    assert payload["counts"]["baselined"] == 0
+    assert not os.path.exists(
+        os.path.join(REPO, "tools", "lint_baseline.json"))
 
 
 def test_driver_json_and_exit_code_on_findings(tmp_path):

@@ -1,0 +1,806 @@
+"""fleetx-lint v2 coverage: the interprocedural dataflow engine and the
+gang-collective lockstep rules (FX007-FX010), per docs/static_analysis.md.
+
+Every rule gets positive + negative + noqa fixtures, and every named bug
+from the PR 6-8 review history is a regression fixture the corresponding
+rule must flag — with the shipped fix shape passing:
+
+- the unilateral stream-dry loop exit            (FX008, PR 6)
+- the early return/raise between paired agreement calls (FX008, PR 6/7)
+- the step-keyed save trigger under the in-step skip    (FX009, PR 6/7)
+- the rank-0-gated collective                     (FX007, the review-pass
+  staple: one rank takes a gang action its peers never mirror)
+- the serving "jit cache pinned at 1" invariant   (FX010, PR 10)
+
+Plus the v2 machinery: SARIF output, ``--changed-only`` git-diff-aware
+selection, and the content-fingerprint result cache.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from fleetx_tpu.lint import render_sarif, run_lint
+from fleetx_tpu.lint.rules import collectives
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.lint
+
+
+def _project(tmp_path, select, **files):
+    """Write dedented sources into tmp_path and lint them."""
+    paths = []
+    for name, src in files.items():
+        p = tmp_path / f"{name}.py"
+        p.write_text(textwrap.dedent(src))
+        paths.append(p)
+    return run_lint(paths, root=tmp_path, select=select)
+
+
+def _rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ========================================================== FX007 fixtures
+
+def test_fx007_collective_under_rank_guard(tmp_path):
+    res = _project(tmp_path, ["collective-under-rank-guard"], m='''
+        """Doc."""
+        import jax
+
+        def sync(coord):
+            """Doc."""
+            if jax.process_index() == 0:
+                coord.barrier("publish")
+    ''')
+    assert _rules_of(res) == ["collective-under-rank-guard"]
+    assert "CoordinationTimeout" in res.findings[0].message
+
+
+def test_fx007_interprocedural_via_call_graph(tmp_path):
+    """The guarded call is three hops from the primitive — only the
+    project call graph can see it."""
+    res = _project(tmp_path, ["collective-under-rank-guard"], helper='''
+        """Doc."""
+
+        def commit(coord):
+            """Doc."""
+            coord.any_flag("ckpt_commit", False)
+
+        def save(coord):
+            """Doc."""
+            commit(coord)
+    ''', main='''
+        """Doc."""
+        import helper
+
+        def fit(coord):
+            """Doc."""
+            if coord.rank == 0:
+                helper.save(coord)
+    ''')
+    assert _rules_of(res) == ["collective-under-rank-guard"]
+    assert res.findings[0].path == "main.py"
+    assert "save" in res.findings[0].message
+
+
+def test_fx007_io_exception_handler_positive(tmp_path):
+    res = _project(tmp_path, ["collective-under-rank-guard"], m='''
+        """Doc."""
+
+        def recover(coord, path):
+            """Doc."""
+            try:
+                data = open(path).read()
+            except OSError:
+                coord.barrier("recover")
+                data = None
+            return data
+    ''')
+    assert _rules_of(res) == ["collective-under-rank-guard"]
+    assert "I/O handler" in res.findings[0].message
+
+
+def test_fx007_sanitized_guard_negative(tmp_path):
+    """An agreement result is gang-uniform: guarding on it is the FIX."""
+    res = _project(tmp_path, ["collective-under-rank-guard"], m='''
+        """Doc."""
+        import jax
+
+        def sync(coord):
+            """Doc."""
+            mine = jax.process_index() == 0
+            if coord.any_flag("elect", mine):
+                coord.barrier("publish")
+            if coord.world > 1:
+                coord.barrier("uniform_guard_is_fine")
+    ''')
+    assert res.findings == []
+
+
+def test_fx007_noqa(tmp_path):
+    res = _project(tmp_path, ["collective-under-rank-guard"], m='''
+        """Doc."""
+
+        def sync(coord):
+            """Doc."""
+            if coord.rank == 0:
+                coord.barrier("x")  # fleetx: noqa[FX007] -- drill-only path
+    ''')
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+def test_fx007_regression_rank0_gated_emergency_save(tmp_path):
+    """PR 6 review staple: rank 0 emergency-saves on preemption while its
+    peers never join the commit vote — the gang wedges mid-shutdown."""
+    bug = '''
+        """Doc."""
+
+        def emergency(coord, save):
+            """Doc."""
+            if coord.rank == 0:
+                save()
+                coord.any_flag("ckpt_commit", False)
+    '''
+    fix = '''
+        """Doc."""
+
+        def emergency(coord, save):
+            """Doc."""
+            save()
+            coord.any_flag("ckpt_commit", False)
+    '''
+    assert _rules_of(_project(tmp_path, ["collective-under-rank-guard"],
+                              bug=bug)) == ["collective-under-rank-guard"]
+    assert _project(tmp_path, ["collective-under-rank-guard"],
+                    fix=fix).findings == []
+
+
+def test_fx007_deep_call_chain_still_propagates(tmp_path):
+    """may-perform-collective must propagate regardless of depth — only
+    the displayed chain is capped (fit -> rollback -> save -> commit vote
+    is already 6 hops in the real engine)."""
+    lines = ['"""Doc."""', "", "", "def f0(coord):", '    """Doc."""',
+             '    coord.barrier("deep")']
+    for i in range(1, 9):
+        lines += ["", "", f"def f{i}(coord):", '    """Doc."""',
+                  f"    f{i - 1}(coord)"]
+    lines += ["", "", "def fit(coord):", '    """Doc."""',
+              "    if coord.rank == 0:", "        f8(coord)"]
+    mod = tmp_path / "deep.py"
+    mod.write_text("\n".join(lines) + "\n")
+    res = run_lint([mod], root=tmp_path,
+                   select=["collective-under-rank-guard"])
+    assert _rules_of(res) == ["collective-under-rank-guard"]
+
+
+# ========================================================== FX008 fixtures
+
+def test_fx008_regression_unilateral_stream_dry_exit(tmp_path):
+    """THE PR 6 bug: a rank whose shard ran dry broke out of the loop
+    unilaterally; its peers wedged in the next loop_flags gather."""
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], bug='''
+        """Doc."""
+
+        def fit(coord, stream):
+            """Doc."""
+            while True:
+                batch = next(stream, None)
+                if batch is None:
+                    break
+                coord.all_gather("loop_flags", {"done": False})
+    ''')
+    assert _rules_of(res) == ["unmatched-agreement-pairing"]
+    assert "peers still looping" in res.findings[0].message
+
+
+def test_fx008_stream_dry_exit_voted_fix_passes(tmp_path):
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], fix='''
+        """Doc."""
+
+        def fit(coord, stream):
+            """Doc."""
+            while True:
+                batch = next(stream, None)
+                votes = coord.all_gather("loop_flags",
+                                         {"done": batch is None})
+                if any(v["done"] for v in votes.values()):
+                    break
+    ''')
+    assert res.findings == []
+
+
+def test_fx008_regression_early_return_commit_vote(tmp_path):
+    """PR 7 shape: local write verification fails, the rank returns before
+    voting — peers block in the two-phase ckpt_commit agreement."""
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], bug='''
+        """Doc."""
+
+        def save(coord, write, verify):
+            """Doc."""
+            write()
+            try:
+                verify()
+            except OSError:
+                return None
+            coord.any_flag("ckpt_commit", False)
+            return True
+    ''')
+    assert _rules_of(res) == ["unmatched-agreement-pairing"]
+    assert "ckpt_commit" in res.findings[0].message or \
+        "any_flag" in res.findings[0].message
+
+
+def test_fx008_commit_vote_failure_voted_fix_passes(tmp_path):
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], fix='''
+        """Doc."""
+
+        def save(coord, write, verify):
+            """Doc."""
+            write()
+            failed = False
+            try:
+                verify()
+            except OSError:
+                failed = True
+            if coord.any_flag("ckpt_commit", failed):
+                return None
+            return True
+    ''')
+    assert res.findings == []
+
+
+def test_fx008_paired_barrier_escape(tmp_path):
+    """The rollback shape: a rank-local raise between X_enter and X_exit
+    strands peers in the exit barrier."""
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], bug='''
+        """Doc."""
+
+        def rollback(coord, stream):
+            """Doc."""
+            coord.barrier("rollback_enter")
+            if next(stream, None) is None:
+                raise RuntimeError("stream dry while rewinding")
+            coord.barrier("rollback_exit")
+    ''')
+    assert _rules_of(res) == ["unmatched-agreement-pairing"]
+    assert "rollback_enter" in res.findings[0].message
+    assert "rollback_exit" in res.findings[0].message
+
+
+def test_fx008_paired_barrier_voted_raise_passes(tmp_path):
+    """The shipped engine fix: vote the rank-local failure, then every
+    rank raises together (uniform escapes are pre-agreed)."""
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], fix='''
+        """Doc."""
+
+        def rollback(coord, stream):
+            """Doc."""
+            coord.barrier("rollback_enter")
+            dry = next(stream, None) is None
+            if coord.any_flag("rewind_dry", dry):
+                raise RuntimeError("stream dry while rewinding")
+            coord.barrier("rollback_exit")
+    ''')
+    assert res.findings == []
+
+
+def test_fx008_missing_closer(tmp_path):
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], m='''
+        """Doc."""
+
+        def enter_only(coord):
+            """Doc."""
+            coord.barrier("phase_enter")
+    ''')
+    assert _rules_of(res) == ["unmatched-agreement-pairing"]
+    assert "phase_exit" in res.findings[0].message
+
+
+def test_fx008_raise_absorbed_by_local_handler_negative(tmp_path):
+    """A raise caught in-function never leaves — the CFG routes it to the
+    handler, not EXIT, so the pairing still closes."""
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], m='''
+        """Doc."""
+
+        def rollback(coord, stream):
+            """Doc."""
+            coord.barrier("rollback_enter")
+            err = None
+            try:
+                if next(stream, None) is None:
+                    raise RuntimeError("dry")
+            except RuntimeError as e:
+                err = str(e)
+            if coord.any_flag("failed", err is not None):
+                raise RuntimeError("agreed abort")
+            coord.barrier("rollback_exit")
+    ''')
+    assert res.findings == []
+
+
+def test_fx008_try_finally_does_not_shadow_outer_handler(tmp_path):
+    """A handler-less try/finally between paired barriers must not hide
+    the outer except: the raise is caught, every rank reaches the closer."""
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], m='''
+        """Doc."""
+
+        def rollback(coord, stream, cleanup):
+            """Doc."""
+            coord.barrier("rollback_enter")
+            caught = False
+            try:
+                try:
+                    if next(stream, None) is None:
+                        raise ValueError("dry")
+                finally:
+                    cleanup()
+            except ValueError:
+                caught = True
+            if coord.any_flag("failed", caught):
+                raise RuntimeError("agreed abort")
+            coord.barrier("rollback_exit")
+    ''')
+    assert res.findings == []
+
+
+def test_fx008_finally_closed_pairing_negative(tmp_path):
+    """`try: ... finally: barrier("x_exit")` GUARANTEES the closer runs on
+    every path — the CFG must route abrupt exits through the finally, not
+    straight to EXIT, or the canonical cleanup idiom gets flagged."""
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], m='''
+        """Doc."""
+
+        def rollback(coord):
+            """Doc."""
+            coord.barrier("rollback_enter")
+            try:
+                if coord.rank == 0:
+                    return None
+            finally:
+                coord.barrier("rollback_exit")
+            return True
+    ''')
+    assert res.findings == []
+
+
+def test_fx008_exit_own_arm_collective_not_counted(tmp_path):
+    """`if rank == 0: barrier(); return` is FX007's finding (collective
+    under a rank guard) — NOT an FX008 'peers go on to...' escape: peers
+    never enter that arm, so the return strands nobody."""
+    res = _project(tmp_path,
+                   ["unmatched-agreement-pairing",
+                    "collective-under-rank-guard"], m='''
+        """Doc."""
+
+        def publish(coord):
+            """Doc."""
+            if coord.rank == 0:
+                coord.barrier("publish")
+                return True
+            return False
+    ''')
+    assert _rules_of(res) == ["collective-under-rank-guard"]
+
+
+def test_fx008_extra_pair_registry(tmp_path, monkeypatch):
+    """docs/static_analysis.md: a new paired primitive is one registry
+    entry — the rule then enforces it with no further code."""
+    monkeypatch.setitem(collectives.EXTRA_PAIRS, "gen_bump", "gen_wait")
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], m='''
+        """Doc."""
+
+        def advance(coord):
+            """Doc."""
+            coord.broadcast("gen_bump", 1)
+    ''')
+    assert _rules_of(res) == ["unmatched-agreement-pairing"]
+    assert "gen_wait" in res.findings[0].message
+
+
+def test_fx008_noqa(tmp_path):
+    res = _project(tmp_path, ["unmatched-agreement-pairing"], m='''
+        """Doc."""
+
+        def fit(coord, stream):
+            """Doc."""
+            while True:
+                if next(stream, None) is None:
+                    break  # fleetx: noqa[unmatched-agreement-pairing] -- single-process path
+                coord.all_gather("loop_flags", {})
+    ''')
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+# ========================================================== FX009 fixtures
+
+def test_fx009_regression_step_keyed_save_trigger(tmp_path):
+    """THE PR 6/7 desync: `step` advances only on finite updates (the
+    in-step skip), so `step % save_steps` fires on different iterations
+    per rank and the laggard sits out the commit rendezvous."""
+    res = _project(tmp_path, ["step-keyed-gang-trigger"], bug='''
+        """Doc."""
+        import jax
+
+        def fit(coord, batches, save_steps, train):
+            """Doc."""
+            step = 0
+            for batch in batches:
+                metrics = jax.device_get(train(batch))
+                if bool(metrics["finite"]):
+                    step += 1
+                if step % save_steps == 0:
+                    coord.any_flag("ckpt_commit", False)
+    ''')
+    assert _rules_of(res) == ["step-keyed-gang-trigger"]
+    assert "vote_round" in res.findings[0].message
+
+
+def test_fx009_vote_round_keyed_trigger_passes(tmp_path):
+    """The shipped fix shape: a counter advanced unconditionally every
+    iteration is lockstep by construction."""
+    res = _project(tmp_path, ["step-keyed-gang-trigger"], fix='''
+        """Doc."""
+        import jax
+
+        def fit(coord, batches, save_steps, train):
+            """Doc."""
+            vote_round = 0
+            for batch in batches:
+                metrics = jax.device_get(train(batch))
+                vote_round += 1
+                if vote_round % save_steps == 0:
+                    coord.any_flag("ckpt_commit", False)
+    ''')
+    assert res.findings == []
+
+
+def test_fx009_device_step_readback_modulo(tmp_path):
+    """`state.step` read back from device diverges under the skip too."""
+    res = _project(tmp_path, ["step-keyed-gang-trigger"], m='''
+        """Doc."""
+        import jax
+
+        def maybe_save(coord, state, k):
+            """Doc."""
+            step = int(jax.device_get(state.step))
+            if step % k == 0:
+                coord.barrier("save")
+    ''')
+    assert _rules_of(res) == ["step-keyed-gang-trigger"]
+
+
+def test_fx009_noqa(tmp_path):
+    res = _project(tmp_path, ["step-keyed-gang-trigger"], m='''
+        """Doc."""
+        import jax
+
+        def maybe_save(coord, state, k):
+            """Doc."""
+            step = int(jax.device_get(state.step))
+            if step % k == 0:
+                coord.barrier("save")  # fleetx: noqa[FX009] -- skip is forced off here
+    ''')
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+# ========================================================== FX010 fixtures
+
+def test_fx010_regression_serving_jit_cache_growth(tmp_path):
+    """The serving invariant 'two jitted programs, jit cache pinned at 1'
+    (docs/serving.md), previously enforced only by tests: a decode loop
+    feeding the jitted step a varying batch slice and a varying static
+    recompiles per distinct size."""
+    res = _project(tmp_path, ["retrace-hazard"], bug='''
+        """Doc."""
+        import jax
+
+        def serve(decode_fn, params, buf, reqs):
+            """Doc."""
+            step = jax.jit(decode_fn, static_argnums=(2,))
+            n = 0
+            out = []
+            for req in reqs:
+                n += 1
+                out.append(step(params, buf[:n], n))
+            return out
+    ''')
+    assert _rules_of(res) == ["retrace-hazard"] * 2
+    msgs = " ".join(f.message for f in res.findings)
+    assert "retraces" in msgs and "static" in msgs
+
+
+def test_fx010_static_shape_loop_passes(tmp_path):
+    """The shipped serving idiom: fixed buffers, constant-length chunk
+    windows, scalars passed as traced values."""
+    res = _project(tmp_path, ["retrace-hazard"], fix='''
+        """Doc."""
+        import jax
+        import numpy as np
+
+        def serve(decode_fn, params, buf, reqs):
+            """Doc."""
+            step = jax.jit(decode_fn)
+            pos = 0
+            out = []
+            for req in reqs:
+                chunk = buf[pos:pos + 32]
+                tokens = np.zeros((1, 32), np.int32)
+                out.append(step(params, tokens, np.int32(pos)))
+                pos += 32
+            return out
+    ''')
+    assert res.findings == []
+
+
+def test_fx010_decorated_static_argnames(tmp_path):
+    res = _project(tmp_path, ["retrace-hazard"], m='''
+        """Doc."""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("width",))
+        def pad(x, width):
+            """Doc."""
+            return x
+
+        def run(xs):
+            """Doc."""
+            out = []
+            for i, x in enumerate(xs):
+                out.append(pad(x, width=i))
+            return out
+    ''')
+    assert _rules_of(res) == ["retrace-hazard"]
+
+
+def test_fx010_varying_constructor_shape(tmp_path):
+    res = _project(tmp_path, ["retrace-hazard"], m='''
+        """Doc."""
+        import jax
+        import numpy as np
+
+        def run(fn, items):
+            """Doc."""
+            step = jax.jit(fn)
+            for item in items:
+                n = len(item)
+                step(np.zeros((n, 4)))
+    ''')
+    assert _rules_of(res) == ["retrace-hazard"]
+
+
+def test_fx010_noqa(tmp_path):
+    res = _project(tmp_path, ["retrace-hazard"], m='''
+        """Doc."""
+        import jax
+
+        def run(fn, xs, buf):
+            """Doc."""
+            step = jax.jit(fn)
+            n = 0
+            for x in xs:
+                n += 1
+                step(buf[:n])  # fleetx: noqa[retrace-hazard] -- one-off warmup sweep
+    ''')
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+# =================================================== engine-level regression
+
+def test_repo_engine_rollback_rewind_is_voted():
+    """The shipped FX008 fix in `restart_from_last_good`: the rewind's
+    rank-local dry-stream failure is voted through `rollback_rewind_dry`
+    before any rank raises between the rollback barriers."""
+    path = os.path.join(REPO, "fleetx_tpu", "core", "engine",
+                        "eager_engine.py")
+    with open(path) as f:
+        src = f.read()
+    assert 'any_flag("rollback_rewind_dry"' in src
+    enter = src.index('barrier("rollback_enter")')
+    exit_ = src.index('barrier("rollback_exit")')
+    vote = src.index('any_flag("rollback_rewind_dry"')
+    assert enter < vote < exit_
+
+
+# ============================================================== SARIF output
+
+def test_render_sarif_schema(tmp_path):
+    res = _project(tmp_path, ["collective-under-rank-guard"], m='''
+        """Doc."""
+
+        def sync(coord):
+            """Doc."""
+            if coord.rank == 0:
+                coord.barrier("x")
+    ''')
+    sarif = render_sarif(res)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "fleetx-lint"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert "FX007" in rule_ids
+    result = run["results"][0]
+    assert result["ruleId"] == "FX007"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "m.py"
+    assert loc["region"]["startLine"] >= 1
+    assert result["partialFingerprints"]["fleetxLint/v1"] == \
+        res.findings[0].fingerprint
+
+
+def test_driver_sarif_flag(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('"""Doc."""\nimport jax\n\n\n@jax.jit\ndef f(x):\n'
+                   '    """Doc."""\n    return float(x)\n')
+    out = tmp_path / "report.sarif"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad),
+         "--no-baseline", "--no-cache", "--sarif", str(out)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    payload = json.loads(out.read_text())
+    assert payload["runs"][0]["results"][0]["ruleId"] == "FX001"
+
+
+# ======================================================== result cache
+
+def test_cache_roundtrip_and_invalidation(tmp_path):
+    src_bad = ('"""Doc."""\nimport jax\n\n\n@jax.jit\ndef f(x):\n'
+               '    """Doc."""\n    return float(x)\n')
+    src_ok = ('"""Doc."""\nimport jax\n\n\n@jax.jit\ndef f(x):\n'
+              '    """Doc."""\n    return x\n')
+    mod = tmp_path / "m.py"
+    cache = tmp_path / "cache.json"
+    mod.write_text(src_bad)
+    kw = dict(root=tmp_path, select=["host-sync-in-traced-code"],
+              cache_path=cache)
+    first = run_lint([mod], **kw)
+    assert len(first.findings) == 1 and cache.exists()
+    warm = run_lint([mod], **kw)
+    assert [f.fingerprint for f in warm.findings] == \
+        [f.fingerprint for f in first.findings]
+    mod.write_text(src_ok)   # content change must invalidate
+    assert run_lint([mod], **kw).findings == []
+
+
+def test_cache_project_scope_rules(tmp_path):
+    src = textwrap.dedent('''
+        """Doc."""
+
+        def sync(coord):
+            """Doc."""
+            if coord.rank == 0:
+                coord.barrier("x")
+    ''')
+    mod = tmp_path / "m.py"
+    mod.write_text(src)
+    cache = tmp_path / "cache.json"
+    kw = dict(root=tmp_path, select=["collective-under-rank-guard"],
+              cache_path=cache)
+    assert len(run_lint([mod], **kw).findings) == 1
+    assert len(run_lint([mod], **kw).findings) == 1    # served from cache
+    mod.write_text(src.replace('coord.rank == 0', 'coord.world > 1'))
+    assert run_lint([mod], **kw).findings == []
+
+
+def test_cache_corrupt_file_degrades_to_cold_run(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text('"""Doc."""\n')
+    cache = tmp_path / "cache.json"
+    cache.write_text("{not json")
+    res = run_lint([mod], root=tmp_path, select=["docstrings"],
+                   cache_path=cache)
+    assert res.findings == []
+
+
+# ======================================================== --changed-only
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "fleetx_lint_cli", os.path.join(REPO, "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _git(repo, *args):
+    return subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.email=t@t", "-c",
+         "user.name=t", *args], capture_output=True, text=True, check=True)
+
+
+def test_changed_only_lints_only_the_diff(tmp_path, monkeypatch, capsys):
+    repo = tmp_path / "repo"
+    (repo / "fleetx_tpu").mkdir(parents=True)
+    good = repo / "fleetx_tpu" / "good.py"
+    good.write_text('"""Doc."""\nimport jax\n\n\n@jax.jit\ndef f(x):\n'
+                    '    """Doc."""\n    return float(x)\n')
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    cli = _load_cli()
+    monkeypatch.setattr(cli, "REPO_ROOT", str(repo))
+    monkeypatch.setattr(cli, "DEFAULT_BASELINE",
+                        str(repo / "baseline.json"))
+    monkeypatch.setattr(cli, "DEFAULT_CACHE", str(repo / ".lint_cache.json"))
+    # clean tree: the committed FX001 is NOT re-reported, and machine
+    # readers still get a FRESH empty report (never a stale file)
+    report = repo / "clean.json"
+    assert cli.main(["--changed-only", "--select",
+                     "host-sync-in-traced-code", "--json",
+                     str(report)]) == 0
+    assert "checked 0 files" in capsys.readouterr().out
+    payload = json.loads(report.read_text())
+    assert payload["clean"] is True and payload["files"] == 0
+    # an untracked bad file IS picked up
+    bad = repo / "fleetx_tpu" / "bad.py"
+    bad.write_text('"""Doc."""\nimport jax\n\n\n@jax.jit\ndef g(x):\n'
+                   '    """Doc."""\n    return float(x)\n')
+    assert cli.main(["--changed-only", "--select",
+                     "host-sync-in-traced-code"]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py" in out and "good.py" not in out
+
+
+def test_changed_only_project_rules_scan_full_tree(tmp_path, monkeypatch,
+                                                  capsys):
+    """With a project-scope rule selected, cross-file context still comes
+    from the whole tree while the report is diff-restricted: the changed
+    caller is flagged even though the collective helper is unchanged."""
+    repo = tmp_path / "repo"
+    (repo / "fleetx_tpu").mkdir(parents=True)
+    helper = repo / "fleetx_tpu" / "helper.py"
+    helper.write_text(textwrap.dedent('''
+        """Doc."""
+
+        def commit(coord):
+            """Doc."""
+            coord.any_flag("ckpt_commit", False)
+    '''))
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    caller = repo / "fleetx_tpu" / "caller.py"
+    caller.write_text(textwrap.dedent('''
+        """Doc."""
+        from fleetx_tpu.helper import commit
+
+        def fit(coord):
+            """Doc."""
+            if coord.rank == 0:
+                commit(coord)
+    '''))
+    cli = _load_cli()
+    monkeypatch.setattr(cli, "REPO_ROOT", str(repo))
+    monkeypatch.setattr(cli, "DEFAULT_BASELINE",
+                        str(repo / "baseline.json"))
+    monkeypatch.setattr(cli, "DEFAULT_CACHE", str(repo / ".lint_cache.json"))
+    assert cli.main(["--changed-only", "--select",
+                     "collective-under-rank-guard"]) == 1
+    out = capsys.readouterr().out
+    assert "caller.py" in out and "FX007" in out
+
+
+# =============================================================== registry
+
+def test_v2_rules_registered_with_unique_codes():
+    from fleetx_tpu.lint import all_rules
+
+    rules = all_rules()
+    for name, code in (("collective-under-rank-guard", "FX007"),
+                       ("unmatched-agreement-pairing", "FX008"),
+                       ("step-keyed-gang-trigger", "FX009"),
+                       ("retrace-hazard", "FX010")):
+        assert name in rules and rules[name].code == code, name
+    codes = [r.code for r in rules.values()]
+    assert len(codes) == len(set(codes))
+    # the scope split drives the cache + --changed-only semantics
+    assert rules["collective-under-rank-guard"].scope == "project"
+    assert rules["retrace-hazard"].scope == "module"

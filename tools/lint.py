@@ -4,8 +4,10 @@ Usage::
 
     python tools/lint.py                      # lint fleetx_tpu/ (all rules)
     python tools/lint.py fleetx_tpu/core      # narrower scope
+    python tools/lint.py --changed-only       # git-diff-aware selection
     python tools/lint.py --select docstrings  # one category
     python tools/lint.py --json report.json   # machine-readable output
+    python tools/lint.py --sarif report.sarif # CI inline annotations
     python tools/lint.py --write-baseline     # accept the current backlog
     python tools/lint.py --list-rules
 
@@ -13,17 +15,51 @@ Exit codes follow ``tools/metrics_report.py``: 0 clean, 1 findings,
 2 usage/internal error.  The default baseline (``tools/lint_baseline.json``)
 is applied when present so legacy findings don't block CI; suppress single
 sites inline with ``# fleetx: noqa[rule-name] -- reason``.
+
+``--changed-only`` selects files from ``git diff HEAD`` plus untracked
+files.  When only module-scope rules are selected those files alone are
+parsed; when a project-scope rule runs (the FX006-FX009 cross-file
+analyses) the full project is still scanned for context and the *report*
+is restricted to the changed files.  Either way the content-fingerprint
+result cache (``.lint_cache.json``, disable with ``--no-cache``) keeps the
+grown repo's lint in seconds.
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+DEFAULT_CACHE = os.path.join(REPO_ROOT, ".lint_cache.json")
+
+#: suffixes the linter understands — ``--changed-only`` ignores the rest
+_LINTABLE = (".py", ".yaml", ".yml")
+
+
+def _changed_files(repo):
+    """Posix relpaths changed vs HEAD plus untracked files, or None when
+    git is unavailable (the caller then falls back to a full run)."""
+    out = set()
+    for args in (["diff", "--name-only", "HEAD", "--"],
+                 ["ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(["git", "-C", repo, *args],
+                                  capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return sorted(
+        rel for rel in out
+        if rel.endswith(_LINTABLE) and os.path.exists(
+            os.path.join(repo, rel)))
 
 
 def main(argv=None) -> int:
@@ -33,6 +69,15 @@ def main(argv=None) -> int:
                     help="files/dirs to lint (default: fleetx_tpu/)")
     ap.add_argument("--json", metavar="OUT",
                     help="write the report as JSON (- for stdout)")
+    ap.add_argument("--sarif", metavar="OUT",
+                    help="write the report as SARIF 2.1.0 (- for stdout)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint files changed vs git HEAD (+ untracked); "
+                         "project-scope rules still scan the full tree "
+                         "for context and report only the changed files")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the content-fingerprint result cache "
+                         f"({DEFAULT_CACHE})")
     ap.add_argument("--select", action="append", default=[],
                     help="rule name/code/category to run (repeatable or "
                          "comma-separated)")
@@ -64,12 +109,49 @@ def main(argv=None) -> int:
     select = [t.strip() for s in args.select for t in s.split(",") if t.strip()]
     skip = [t.strip() for s in args.skip for t in s.split(",") if t.strip()]
 
-    if args.write_baseline and (select or skip):
+    if args.write_baseline and (select or skip or args.changed_only):
         # a filtered run would overwrite the baseline with a subset,
-        # silently dropping every unselected rule's accepted findings
-        print("error: --write-baseline requires a full-rule run "
-              "(drop --select/--skip)", file=sys.stderr)
+        # silently dropping every unselected rule's (or unchanged file's)
+        # accepted findings
+        print("error: --write-baseline requires a full-rule run over the "
+              "full tree (drop --select/--skip/--changed-only)",
+              file=sys.stderr)
         return 2
+
+    only_paths = None
+    empty_result = None
+    if args.changed_only:
+        scope_prefixes = tuple(
+            os.path.relpath(os.path.abspath(p), REPO_ROOT).replace(os.sep, "/")
+            for p in paths)
+        changed = _changed_files(REPO_ROOT)
+        if changed is None:
+            print("warning: git unavailable — falling back to a full run",
+                  file=sys.stderr)
+        else:
+            changed = [rel for rel in changed
+                       if any(rel == p or rel.startswith(p.rstrip("/") + "/")
+                              for p in scope_prefixes)]
+            try:
+                from fleetx_tpu.lint.core import resolve_rules
+
+                selected = resolve_rules(select or None, skip or None)
+            except KeyError as e:
+                print(f"error: {e.args[0]}", file=sys.stderr)
+                return 2
+            if not changed:
+                # a clean result through the NORMAL emit path: --json /
+                # --sarif consumers get a fresh (empty) report instead of
+                # silently inheriting a stale file from a previous run
+                empty_result = core.LintResult(
+                    findings=[], suppressed=[], baselined=[],
+                    rules=[r.name for r in selected], files=0)
+            elif any(r.scope == "project" for r in selected):
+                # cross-file context needed: scan the full project, report
+                # only the changed files
+                only_paths = set(changed)
+            else:
+                paths = [os.path.join(REPO_ROOT, rel) for rel in changed]
 
     baseline = args.baseline
     if baseline is None and not args.no_baseline and \
@@ -78,12 +160,17 @@ def main(argv=None) -> int:
     if args.no_baseline or args.write_baseline:
         baseline = None
 
-    try:
-        result = run_lint(paths, root=REPO_ROOT, select=select or None,
-                          skip=skip or None, baseline_path=baseline)
-    except KeyError as e:
-        print(f"error: {e.args[0]}", file=sys.stderr)
-        return 2
+    cache_path = None if args.no_cache else DEFAULT_CACHE
+    if empty_result is not None:
+        result = empty_result
+    else:
+        try:
+            result = run_lint(paths, root=REPO_ROOT, select=select or None,
+                              skip=skip or None, baseline_path=baseline,
+                              cache_path=cache_path, only_paths=only_paths)
+        except KeyError as e:
+            print(f"error: {e.args[0]}", file=sys.stderr)
+            return 2
 
     if args.write_baseline:
         out_path = args.baseline or DEFAULT_BASELINE
@@ -97,6 +184,15 @@ def main(argv=None) -> int:
             print(payload)
         else:
             with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    if args.sarif:
+        from fleetx_tpu.lint import render_sarif
+
+        payload = json.dumps(render_sarif(result), indent=1)
+        if args.sarif == "-":
+            print(payload)
+        else:
+            with open(args.sarif, "w") as f:
                 f.write(payload + "\n")
     print(render_text(result, verbose=args.verbose))
     return 1 if result.findings else 0
