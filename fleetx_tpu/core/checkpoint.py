@@ -704,27 +704,79 @@ def _verify_payload_or_raise(path: str, step: int) -> Optional[dict]:
     return manifest
 
 
-def load_params(directory: str, step: Optional[int] = None) -> Any:
+def _check_spec_provenance(meta: Optional[dict], path: str) -> None:
+    """Both codecs run restores through this: a checkpoint stamped with a
+    DIFFERENT registry fingerprint (``parallel/rules.py`` changed since
+    the save) is loud in the logs — resharding across rule revisions is
+    supported (checkpoints are topology-free), but it must never be
+    invisible."""
+    if not meta:
+        return
+    from fleetx_tpu.parallel import rules as rules_lib
+
+    stamped = meta.get("spec_registry")
+    if stamped and stamped != rules_lib.registry_fingerprint():
+        logger.warning(
+            "checkpoint %s was saved under partition-rule registry %s but "
+            "the current registry is %s (family %s) — the restore re-shards "
+            "onto the CURRENT rules; run tools/shardcheck.py if this is "
+            "unexpected", path, stamped, rules_lib.registry_fingerprint(),
+            meta.get("spec_family"))
+
+
+def load_params(directory: str, step: Optional[int] = None,
+                mesh: Any = None, family: Optional[str] = None,
+                layout: Any = None) -> Any:
     """Restore only the params subtree of a saved TrainState.
 
     Eval/generation tools have no optimizer, so they can't construct the
     full abstract TrainState; instead the checkpoint's own metadata supplies
     shapes/dtypes for a structure-faithful restore, and ``params`` is
     extracted from the result.
+
+    With a ``mesh`` (plus ``family``, defaulting to the one stamped in the
+    checkpoint meta by ``EagerEngine.save``), each leaf restores DIRECTLY
+    onto its registry sharding (``parallel/rules.py``) — Orbax loads every
+    shard to its destination devices instead of materialising the whole
+    tree replicated first, which is what lets a large checkpoint load on a
+    mesh whose per-device HBM cannot hold the full tree.
     """
     step = step if step is not None else latest_step(directory)
     assert step is not None, f"no checkpoint found under {directory}"
     step_path = os.path.abspath(_step_dir(directory, step))
     _verify_payload_or_raise(step_path, int(step))
+    step_meta = _read_meta(step_path)
+    _check_spec_provenance(step_meta, step_path)
     path = os.path.join(step_path, "state")
     ckptr = _get_checkpointer()
     md = ckptr.metadata(path)
     tree = getattr(md, "item_metadata", md)
-    abstract = jax.tree.map(
-        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), tree,
+    sharding_for = None
+    if mesh is not None:
+        from fleetx_tpu.parallel import rules as rules_lib
+
+        family = family or (step_meta or {}).get("spec_family")
+        if family is None:
+            logger.warning("load_params: no spec family stamped or given — "
+                           "restoring replicated")
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def sharding_for(kp, m):
+                name = "/".join(rules_lib._keystr(k) for k in kp)
+                return NamedSharding(mesh, PartitionSpec(
+                    *rules_lib.spec_for(family, name, tuple(m.shape),
+                                        layout)))
+    def abstract_leaf(kp, m):
+        sharding = sharding_for(kp, m) if sharding_for else None
+        return jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding)
+
+    abstract = jax.tree_util.tree_map_with_path(
+        abstract_leaf, tree,
         is_leaf=lambda m: hasattr(m, "shape") and hasattr(m, "dtype"))
     state = ckptr.restore(path, abstract)
-    logger.info("restored params from %s (step %d)", path, step)
+    logger.info("restored params from %s (step %d%s)", path, step,
+                ", registry-sharded" if sharding_for else "")
     return state["params"]
 
 
@@ -757,6 +809,9 @@ def load_checkpoint(directory: str, step: int, abstract_state: Any,
     """
     path = os.path.abspath(_step_dir(directory, step))
     manifest = _verify_payload_or_raise(path, int(step))
+    # spec provenance covers BOTH codecs: the npz branch and the Orbax
+    # branch below re-shard onto the CURRENT registry either way
+    _check_spec_provenance(_read_meta(path), path)
     if os.path.exists(os.path.join(path, _LOCAL_STATE)):
         reg = get_registry()
         t0 = time.perf_counter()

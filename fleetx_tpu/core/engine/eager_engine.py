@@ -39,9 +39,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fleetx_tpu.core import checkpoint as ckpt_lib
 from fleetx_tpu.observability import MemoryMonitor, Observability, flight
 from fleetx_tpu.observability.trace import ProfilerWindow
+from fleetx_tpu.parallel import rules as rules_lib
 from fleetx_tpu.parallel.mesh import build_mesh
-from fleetx_tpu.parallel.sharding import (make_axis_rules, zero_grad_specs,
-                                          zero_sharding)
+from fleetx_tpu.parallel.sharding import zero_grad_specs, zero_sharding
 from fleetx_tpu.resilience import Resilience, TrainingAborted, coordination
 from fleetx_tpu.utils.log import logger, set_rank_context
 
@@ -62,8 +62,25 @@ class TrainState(struct.PyTreeNode):
     scaler: Optional[ScalerState] = None
 
 
-def _named_shardings(abstract_tree: Any, mesh: Mesh, rules) -> Any:
-    """Logical-annotation → NamedSharding tree (replicated where unboxed)."""
+def _named_shardings(abstract_tree: Any, mesh: Mesh, rules,
+                     family: Optional[str] = None,
+                     layout: Optional[rules_lib.SpecLayout] = None) -> Any:
+    """Abstract state → NamedSharding tree, resolved through the
+    partition-rule registry (``parallel/rules.py``) for known model
+    families — specs are DATA matched against leaf names, statically
+    auditable by ``tools/shardcheck.py``, and an unmatched non-scalar leaf
+    fails HERE (at prepare) instead of at jit bind time.
+
+    Modules that declare no ``spec_family`` fall back to the flax logical
+    annotations (replicated where unboxed) with a warning — custom task
+    modules keep working, they just forgo the static audit.
+    """
+    if family is not None:
+        return rules_lib.named_shardings(abstract_tree, mesh, family, layout)
+    logger.warning(
+        "module declares no spec_family — resolving shardings from flax "
+        "logical metadata; register the model in parallel/rules.py "
+        "PARTITION_RULES to get shardcheck coverage")
     specs = nn.get_partition_spec(abstract_tree)
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, nn.logical_to_mesh_axes(spec, rules)),
@@ -89,8 +106,9 @@ def _device_hbm_gb(dist: dict) -> float:
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Global batches are sharded over the combined data axes (reference
-    ``env.get_data_world_size``: dp x sharding, ``utils/env.py:76-96``)."""
-    return NamedSharding(mesh, P(("data", "fsdp")))
+    ``env.get_data_world_size``: dp x sharding, ``utils/env.py:76-96``);
+    the axes come from the registry's ``batch`` rule, not a literal."""
+    return NamedSharding(mesh, rules_lib.batch_spec())
 
 
 from fleetx_tpu.core.engine.basic_engine import BasicEngine
@@ -208,7 +226,14 @@ class EagerEngine(BasicEngine):
                 "a multi-process run on a process-local mesh requires "
                 "Engine.save_load.per_rank_dirs: true — shared checkpoint "
                 "storage only composes with a mesh that spans processes")
-        self.rules = make_axis_rules(dist)
+        # partition-rule registry (parallel/rules.py): the layout is the
+        # logical->mesh table (also the flax activation-constraint context)
+        # and the family names the PARTITION_RULES table that shards this
+        # module's parameter tree — specs are data, audited statically by
+        # tools/shardcheck.py before they ever reach a jit bind
+        self.spec_layout = rules_lib.SpecLayout.from_dist_config(dist)
+        self.spec_family = rules_lib.family_of(module)
+        self.rules = self.spec_layout.axis_rules()
         self.sharding_stage = int((dist.get("sharding") or {}).get("sharding_stage") or 0)
         self.sharding_offload = bool(
             (dist.get("sharding") or {}).get("sharding_offload"))
@@ -325,7 +350,9 @@ class EagerEngine(BasicEngine):
         with self._ctx():
             make_state = self._make_state_fn(sample_batch)
             abstract = jax.eval_shape(make_state, self._base_rng)
-            shardings = _named_shardings(abstract, self.mesh, self.rules)
+            shardings = _named_shardings(abstract, self.mesh, self.rules,
+                                         family=self.spec_family,
+                                         layout=self.spec_layout)
             if self.sharding_stage in (1, 2) and self.mesh.shape["fsdp"] > 1:
                 # ZeRO-1/2: shard optimizer moments over fsdp while params
                 # stay replicated (reference group_sharded_parallel
@@ -1609,7 +1636,12 @@ class EagerEngine(BasicEngine):
                 self.output_dir, step, meta.unbox(self.state),
                 meta={"consumed_samples": self._consumed_samples,
                       "epoch": getattr(self, "_epoch", self._start_epoch),
-                      "seed": self.seed},
+                      "seed": self.seed,
+                      # spec provenance (parallel/rules.py): both codecs
+                      # stamp the registry that sharded this state, so a
+                      # restore under drifted rules is visible in the meta
+                      "spec_family": self.spec_family,
+                      "spec_registry": rules_lib.registry_fingerprint()},
                 async_save=self.async_save)
         if self.mem is not None:
             # checkpoint saves materialize host copies / extra buffers —

@@ -84,7 +84,7 @@ class InferenceEngine:
         from flax import linen as nn
         from jax.sharding import NamedSharding
 
-        from fleetx_tpu.parallel.sharding import make_axis_rules
+        from fleetx_tpu.parallel.rules import SpecLayout
         from fleetx_tpu.utils.export import load_param_specs
 
         specs = load_param_specs(model_dir)
@@ -92,7 +92,9 @@ class InferenceEngine:
             raise ValueError(
                 f"{model_dir} has no param_specs in meta.json — re-export "
                 f"with a current tools/export.py to serve tensor-parallel")
-        rules = make_axis_rules({})
+        # the export carries LOGICAL axis names; the registry's canonical
+        # layout table (parallel/rules.py) maps them to this mesh
+        rules = SpecLayout().axis_rules()
         self._param_shardings = jax.tree.map(
             lambda s: NamedSharding(
                 self.mesh, nn.logical_to_mesh_axes(s, rules)),

@@ -31,6 +31,12 @@ class BasicModule:
     leading dim is the (global) batch.
     """
 
+    #: partition-rule registry family (``parallel/rules.py``): subclasses
+    #: declare which PARTITION_RULES table shards their parameter tree;
+    #: None = unknown (consumers fall back to flax logical metadata with a
+    #: warning, and shardcheck refuses the config)
+    spec_family: Any = None
+
     def __init__(self, cfg: Any):
         self.cfg = cfg
         self.model = self.get_model()
@@ -136,6 +142,12 @@ class LanguageModule(BasicModule):
 
 class GPTModule(LanguageModule):
     """GPT pretraining task (reference ``language_module.py:112-178``)."""
+
+    @property
+    def spec_family(self) -> str:
+        """``gpt_moe`` when the MLP stack is mixture-of-experts, ``gpt``
+        otherwise — the two families carry different MLP rule tables."""
+        return "gpt_moe" if self.model_cfg.moe_num_experts > 0 else "gpt"
 
     def __init__(self, cfg: Any):
         from fleetx_tpu.models.gpt.model import config_from_dict

@@ -17,6 +17,12 @@ AST-based rule framework that catches those classes at commit time:
   rules: collectives under rank-divergent guards (FX007), unmatched
   agreement pairings / unilateral loop exits (FX008), step-keyed gang
   triggers (FX009) and loop-varying jit retrace hazards (FX010),
+- the shardcheck rules over the partition-rule registry
+  (``parallel/rules.py``): every YAML-zoo config's ``eval_shape``-derived
+  param tree fully + unambiguously matched with divisible sharded dims
+  (FX011/FX012, driven by ``parallel/shardcheck.py`` +
+  ``tools/shardcheck.py``), and no hand-wired spec table outside the
+  registry (FX013),
 - plus the docstring conventions previously enforced by
   ``codestyle/check_docstrings.py``, unified under the same registry,
   suppression syntax and exit-code convention.

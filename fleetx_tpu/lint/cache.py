@@ -2,15 +2,17 @@
 
 Two granularities, matching the two rule scopes in ``core.Rule``:
 
-- **module-scope** rules (FX001-FX005, FX010, docstrings) depend only on
-  one file's text plus a small stable context (FX004's mesh axes).  Their
-  findings are cached per ``(relpath, sha1(text), rule, context_key)``.
-- **project-scope** rules (FX006-FX009) read cross-file state — the config
-  zoo, the call graph over ``fleetx_tpu/`` + ``tools/`` + ``tasks/``.
-  Their findings are cached against a whole-project content digest; any
-  file change re-runs them (correct by construction, and the no-change
-  case — CI re-running ``tools/lint.py`` for the gate, ``--changed-only``
-  with a clean tree — is the one worth making instant).
+- **module-scope** rules (FX001-FX005, FX010, FX013, docstrings) depend
+  only on one file's text plus a small stable context (FX004's mesh
+  axes).  Their findings are cached per
+  ``(relpath, sha1(text), rule, context_key)``.
+- **project-scope** rules (FX006-FX009, FX011/FX012) read cross-file
+  state — the config zoo, the call graph over ``fleetx_tpu/`` +
+  ``tools/`` + ``tasks/``.  Their findings are cached against
+  ``Rule.project_digest`` — the whole-project content digest by default
+  (any file change re-runs them), or a narrower dependency fingerprint
+  for the expensive shardcheck audit (registry + models + configs —
+  ``lint/rules/sharding.py``) so unrelated code edits keep it warm.
 
 Cached findings are raw: fingerprints, ``noqa`` suppression and baseline
 filtering are recomputed on every run (they read current line text), so a

@@ -97,6 +97,14 @@ class Rule:
         also depends on a stable project fact (FX004: the mesh axes)."""
         return ""
 
+    def project_digest(self, project: "Project") -> str:
+        """Cache key for project-scope results. Defaults to the
+        whole-project digest (any byte change re-runs); rules whose
+        dependency set is narrower and expensive to recompute (the
+        shardcheck audit: registry + models + configs) override this so
+        unrelated code edits keep their cached result warm."""
+        return project.digest()
+
     def finding(self, path: str, line: int, col: int, message: str) -> Finding:
         return Finding(rule=self.name, code=self.code, path=path,
                        line=max(int(line), 1), col=int(col), message=message)
@@ -177,6 +185,7 @@ class Project:
         self.config_paths: list[Path] = []
         self._lines_cache: dict[str, list[str]] = {}
         self._mesh_axes: Optional[tuple] = None
+        self._logical_axes: Optional[tuple] = None
         self._digest: Optional[str] = None
         self._collect()
 
@@ -240,36 +249,64 @@ class Project:
             return lines[lineno - 1]
         return ""
 
-    def mesh_axes(self) -> tuple:
-        """Mesh axis names declared by ``fleetx_tpu/parallel/mesh.py``.
+    def _declared_tuple(self, varname: str,
+                        relpaths: tuple) -> Optional[tuple]:
+        """Statically parse ``VARNAME = ("...", ...)`` from the first of
+        ``relpaths`` that declares it — linting never imports jax."""
+        for rel in relpaths:
+            src = self.root / rel
+            if not src.exists():
+                continue
+            try:
+                tree = ast.parse(src.read_text(encoding="utf-8"))
+            except (SyntaxError, OSError):
+                continue
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == varname
+                        for t in node.targets):
+                    val = node.value
+                    if isinstance(val, (ast.Tuple, ast.List)):
+                        names = [e.value for e in val.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str)]
+                        if names:
+                            return tuple(names)
+        return None
 
-        Parsed statically (``MESH_AXES = (...)``) so linting never imports
-        jax; falls back to the canonical five axes when the file is absent
-        (fixture projects).
+    def mesh_axes(self) -> tuple:
+        """Mesh axis names — ONE source for lint and runtime alike: the
+        partition-rule registry's ``MESH_AXES`` literal
+        (``fleetx_tpu/parallel/rules.py``; ``parallel/mesh.py`` imports
+        it from there, and is kept as a parse fallback for fixture
+        projects that predate the registry). Falls back to the canonical
+        five axes when neither file is present.
         """
         if self._mesh_axes is not None:
             return self._mesh_axes
         default = ("pipe", "data", "fsdp", "seq", "tensor")
-        mesh_py = self.root / "fleetx_tpu" / "parallel" / "mesh.py"
-        axes = None
-        if mesh_py.exists():
-            try:
-                tree = ast.parse(mesh_py.read_text(encoding="utf-8"))
-                for node in tree.body:
-                    if isinstance(node, ast.Assign) and any(
-                            isinstance(t, ast.Name) and t.id == "MESH_AXES"
-                            for t in node.targets):
-                        val = node.value
-                        if isinstance(val, (ast.Tuple, ast.List)):
-                            names = [e.value for e in val.elts
-                                     if isinstance(e, ast.Constant)
-                                     and isinstance(e.value, str)]
-                            if names:
-                                axes = tuple(names)
-            except (SyntaxError, OSError):
-                axes = None
+        axes = self._declared_tuple(
+            "MESH_AXES", ("fleetx_tpu/parallel/rules.py",
+                          "fleetx_tpu/parallel/mesh.py"))
         self._mesh_axes = axes or default
         return self._mesh_axes
+
+    def logical_axes(self) -> tuple:
+        """Logical axis vocabulary declared by the registry
+        (``parallel/rules.py LOGICAL_AXES``) — FX013 uses it to recognise
+        hand-wired rule tables; the canonical vocabulary is the fallback
+        for fixture projects (same convention as :meth:`mesh_axes`).
+        Memoized like ``mesh_axes`` — FX013 reads it per scanned file."""
+        if self._logical_axes is not None:
+            return self._logical_axes
+        default = ("batch", "vocab", "mlp", "heads", "kv", "layers",
+                   "pipe_stage", "pipe_repeat", "act_stage", "norm",
+                   "embed", "act_seq", "act_embed", "act_heads", "act_kv",
+                   "act_vocab", "expert", "act_expert", "kv_pages",
+                   "page_slot")
+        self._logical_axes = self._declared_tuple(
+            "LOGICAL_AXES", ("fleetx_tpu/parallel/rules.py",)) or default
+        return self._logical_axes
 
     def config_files(self) -> list[Path]:
         """YAML files in scope: the config zoo dirs plus any scanned YAML."""
@@ -465,7 +502,7 @@ def _run_rule(rule: Rule, project: Project, cache) -> list[Finding]:
             out.extend(rule.check_module(module, project))
         return out
     if rule.scope == "project":
-        digest = f"{project.digest()}|{rule.context_key(project)}"
+        digest = f"{rule.project_digest(project)}|{rule.context_key(project)}"
         cached = cache.get_project(rule.name, digest)
         if cached is not None:
             return cached

@@ -8,5 +8,6 @@ from fleetx_tpu.lint.rules import (  # noqa: F401
     prng,
     pspec,
     retrace,
+    sharding,
     tracing,
 )

@@ -4,10 +4,12 @@ A ``PartitionSpec("modle")`` typo does not fail at construction — GSPMD
 only rejects it when the jit actually binds the spec to a mesh, which for a
 cold-start 175B config is minutes into compilation (and under
 ``shard_map`` it can silently mean "replicated").  The mesh's axis
-vocabulary is a closed set declared once (``fleetx_tpu/parallel/mesh.py``:
-``MESH_AXES``), so the check is purely static: every string literal inside
-a ``PartitionSpec(...)`` / ``P(...)`` call (including nested tuples like
-``P(("data", "fsdp"))``) must be a declared axis name.
+vocabulary is a closed set declared once by the partition-rule registry
+(``fleetx_tpu/parallel/rules.py``: ``MESH_AXES`` — the same source the
+runtime mesh and shardcheck consume), so the check is purely static:
+every string literal inside a ``PartitionSpec(...)`` / ``P(...)`` call
+(including nested tuples like ``P(("data", "fsdp"))``) must be a declared
+axis name.
 
 Logical axis names (``nn.with_logical_partitioning``) are out of scope —
 they pass through the rule table in ``parallel/sharding.py`` and never
@@ -44,7 +46,7 @@ class PSpecMeshMismatch(Rule):
     name = "pspec-mesh-mismatch"
     code = "FX004"
     description = ("PartitionSpec axis literal not declared in "
-                   "parallel/mesh.py MESH_AXES — fails at jit bind time")
+                   "parallel/rules.py MESH_AXES — fails at jit bind time")
 
     def context_key(self, project: Project) -> str:
         """Findings depend on the declared mesh axes, not just the file."""
@@ -70,5 +72,5 @@ class PSpecMeshMismatch(Rule):
                             f"PartitionSpec axis '{name}' is not a mesh "
                             f"axis — declared axes are "
                             f"{tuple(project.mesh_axes())} "
-                            f"(parallel/mesh.py MESH_AXES)"))
+                            f"(parallel/rules.py MESH_AXES)"))
         return out

@@ -1,0 +1,320 @@
+"""Shardcheck rules: the partition-rule registry audited statically.
+
+Three rules close the loop the registry (``parallel/rules.py``) opened —
+sharding specs are data, so a static pass can verify them on CPU CI
+instead of a jit bind discovering drift minutes into a pod compile:
+
+- **FX011 shard-rule-coverage** (project scope): derives every YAML-zoo
+  config's abstract parameter tree with ``jax.eval_shape`` (shape-level,
+  no FLOPs — ``parallel/shardcheck.py``) and flags leaves no rule
+  matches, leaves matched by conflicting rules, rule templates that
+  cannot apply (rank mismatch / unknown logical axis), oversized
+  fully-replicated leaves (the forgotten-spec hazard) and configs that
+  cannot be audited at all.
+- **FX012 shard-rule-health** (project scope): dead rules (no audited
+  config of the family ever matches them — anchored to the pattern's
+  line in ``parallel/rules.py``), families no zoo config exercises, and
+  sharded dims not divisible by their mesh degree for a config's
+  declared layout.
+- **FX013 hand-wired-spec-table** (module scope, pure AST): a partition
+  rule table (name→spec pairs) or a ``PartitionSpec`` built from literal
+  mesh-axis names OUTSIDE ``parallel/rules.py`` — the drift the registry
+  exists to end. Zero-baseline enforced like every other rule.
+
+FX011/FX012 are the only rules that import jax (lazily, inside
+``check_project``); their result cache is keyed on the registry + model +
+config fingerprints (:func:`audit_fingerprint`, stdlib-only), so a warm
+``tools/lint.py`` run with an unrelated code edit never pays the jax
+import, while editing the registry, a model, or a config re-audits.
+Projects without ``fleetx_tpu/parallel/rules.py`` (lint fixture trees)
+are skipped entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from typing import Iterable, Optional
+
+from fleetx_tpu.lint import analysis
+from fleetx_tpu.lint.core import Finding, Project, Rule, SourceModule, register
+
+_RULES_RELPATH = "fleetx_tpu/parallel/rules.py"
+
+#: what the audit's result depends on — mirrored by the audit driver's
+#: imports (parallel/shardcheck.py reads the registry, builds the models
+#: via core/module.py + models/** [+ ops/** QAT wrappers], derives the
+#: serving pool shapes from serving/paged_cache.init_pool, and loads the
+#: zoo through utils/config.parse_config); kept HERE because the
+#: fingerprint must be computable without importing jax (a warm cache hit
+#: must stay instant)
+_FINGERPRINT_FILES = (_RULES_RELPATH,
+                      "fleetx_tpu/parallel/shardcheck.py",
+                      "fleetx_tpu/core/module.py",
+                      "fleetx_tpu/serving/paged_cache.py",
+                      "fleetx_tpu/utils/config.py")
+_FINGERPRINT_DIRS = ("fleetx_tpu/models", "fleetx_tpu/ops",
+                     "fleetx_tpu/configs", "projects")
+
+_PSPEC_NAMES = {"jax.sharding.PartitionSpec",
+                "jax.interpreters.pxla.PartitionSpec",
+                "jax.experimental.pjit.PartitionSpec",
+                "PartitionSpec"}
+
+#: tools/shardcheck.py's positional-config restriction — lives HERE (not
+#: in parallel/shardcheck.py) so reading it never imports jax; folded
+#: into the FX011/FX012 cache keys via context_key. Dead-rule accounting
+#: is skipped under a filter (a partial zoo cannot prove a rule dead).
+_config_filter: Optional[tuple] = None
+
+
+def set_config_filter(paths: Optional[Iterable[str]]) -> None:
+    """Restrict FX011/FX012 to specific config files (None = whole zoo)."""
+    global _config_filter
+    _config_filter = tuple(sorted(paths)) if paths else None
+
+
+def get_config_filter() -> Optional[tuple]:
+    """The active config restriction (see :func:`set_config_filter`)."""
+    return _config_filter
+
+
+def audit_fingerprint(root) -> str:
+    """Content hash of the shardcheck dependency set (stdlib walk)."""
+    h = hashlib.sha1()
+
+    def feed(relpath: str) -> None:
+        try:
+            with open(os.path.join(str(root), relpath), "rb") as f:
+                payload = f.read()
+        except OSError:
+            return
+        h.update(relpath.encode("utf-8") + b"\0")
+        h.update(hashlib.sha1(payload).digest())
+
+    for rel in _FINGERPRINT_FILES:
+        feed(rel)
+    for d in _FINGERPRINT_DIRS:
+        base = os.path.join(str(root), d)
+        for dirpath, _, names in sorted(os.walk(base)):
+            for name in sorted(names):
+                if name.endswith((".py", ".yaml", ".yml")):
+                    feed(os.path.relpath(os.path.join(dirpath, name),
+                                         str(root)).replace(os.sep, "/"))
+    return h.hexdigest()
+
+
+def _zoo_report(project: Project) -> Optional[dict]:
+    """The shared zoo audit, computed once per Project (FX011 and FX012
+    both read it). None when this tree carries no registry (fixtures) or
+    the audit stack cannot import; import failure is reported by FX011."""
+    cached = getattr(project, "_shardcheck_report", False)
+    if cached is not False:
+        return cached
+    report: Optional[dict] = None
+    if (project.root / _RULES_RELPATH).exists():
+        try:
+            from fleetx_tpu.parallel import shardcheck
+
+            report = shardcheck.audit_zoo(str(project.root),
+                                          only=_config_filter)
+        except Exception as e:  # noqa: BLE001 — surfaced as a finding
+            report = {"issues": [{
+                "kind": "audit-error", "family": "?", "leaf": "",
+                "config": _RULES_RELPATH,
+                "message": f"shardcheck audit could not run: "
+                           f"{type(e).__name__}: {e}"}],
+                "dead_rules": [], "configs": 0, "families": {}}
+    project._shardcheck_report = report
+    return report
+
+
+def _pattern_line(project: Project, pattern: str,
+                  family: str = "") -> int:
+    """Line of a rule's regex literal inside parallel/rules.py (1 when it
+    cannot be located — e.g. a pattern built at runtime).
+
+    A pattern literal can occur more than once (a rule inlined in one
+    family's ``PARTITION_RULES`` entry and repeated in a shared
+    ``_GPT_*`` table), so occurrences INSIDE the family's own
+    ``"family": (...)`` span win; rules the family pulls in from a shared
+    table fall back to the first (shared-table) occurrence — which is
+    where that rule actually lives."""
+    if not pattern:
+        return 1
+    try:
+        text = (project.root / _RULES_RELPATH).read_text(encoding="utf-8")
+    except OSError:
+        return 1
+    lines = text.splitlines()
+    hits = [i for i, line in enumerate(lines, start=1) if pattern in line]
+    if not hits:
+        return 1
+    if family and len(hits) > 1:
+        start = next((i for i, line in enumerate(lines, start=1)
+                      if f'"{family}":' in line), None)
+        if start is not None:
+            end = next((i for i, line in enumerate(
+                lines[start:], start=start + 1)
+                if line.strip().startswith('"') and '": ' in line),
+                len(lines) + 1)
+            in_span = [h for h in hits if start <= h < end]
+            if in_span:
+                return in_span[0]
+    return hits[0]
+
+
+@register
+class ShardRuleCoverage(Rule):
+    """Every zoo config's param tree fully + unambiguously matched."""
+
+    name = "shard-rule-coverage"
+    code = "FX011"
+    category = "shardcheck"
+    description = ("model leaf unmatched/ambiguous/oversized-replicated "
+                   "under the partition-rule registry (parallel/rules.py) "
+                   "for a YAML-zoo config")
+    scope = "project"
+    scans_configs = True
+
+    KINDS = ("unmatched", "ambiguous", "rank-mismatch", "unknown-axis",
+             "replicated-large", "audit-error")
+
+    def context_key(self, project: Project) -> str:
+        return repr(_config_filter)
+
+    def project_digest(self, project: Project) -> str:
+        return audit_fingerprint(project.root)
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        report = _zoo_report(project)
+        if report is None:
+            return
+        for issue in report["issues"]:
+            if issue["kind"] not in self.KINDS:
+                continue
+            yield self.finding(
+                issue.get("config", _RULES_RELPATH), 1, 0,
+                f"[{issue['kind']}] {issue['message']} (consumers: "
+                f"engine prepare, zero_grad_specs, both checkpoint "
+                f"codecs, auto_layout resolve this leaf through the "
+                f"registry)")
+
+
+@register
+class ShardRuleHealth(Rule):
+    """No dead rules; sharded dims divide their mesh degrees."""
+
+    name = "shard-rule-health"
+    code = "FX012"
+    category = "shardcheck"
+    description = ("dead partition rule, unexercised family, or sharded "
+                   "dim not divisible by its mesh degree for a config's "
+                   "layout")
+    scope = "project"
+    scans_configs = True
+
+    def context_key(self, project: Project) -> str:
+        return repr(_config_filter)
+
+    def project_digest(self, project: Project) -> str:
+        return audit_fingerprint(project.root)
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        report = _zoo_report(project)
+        if report is None:
+            return
+        for issue in report["issues"]:
+            if issue["kind"] != "indivisible":
+                continue
+            yield self.finding(issue.get("config", _RULES_RELPATH), 1, 0,
+                               f"[indivisible] {issue['message']}")
+        for dead in report["dead_rules"]:
+            yield self.finding(
+                _RULES_RELPATH,
+                _pattern_line(project, dead["pattern"],
+                              family=dead.get("family", "")), 0,
+                f"[dead-rule] {dead['message']}")
+
+
+@register
+class HandWiredSpecTable(Rule):
+    """Partition tables / literal-axis PartitionSpecs outside rules.py."""
+
+    name = "hand-wired-spec-table"
+    code = "FX013"
+    description = ("hand-wired partition table or PartitionSpec with "
+                   "literal mesh axes outside parallel/rules.py — the "
+                   "registry is the single spec source")
+
+    def context_key(self, project: Project) -> str:
+        return ",".join(project.mesh_axes()) + "|" + \
+            ",".join(project.logical_axes())
+
+    # ---------------------------------------------------------------- helpers
+    @staticmethod
+    def _axis_strings(node: ast.AST) -> Iterable[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                yield from HandWiredSpecTable._axis_strings(e)
+
+    def _is_rule_pair(self, node: ast.AST, axes: set, aliases) -> bool:
+        """A ``("name-ish", spec-ish)`` 2-tuple: the shape of one rule."""
+        if not isinstance(node, (ast.Tuple, ast.List)) or len(node.elts) != 2:
+            return False
+        first, second = node.elts
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            return False
+        if isinstance(second, ast.Call) and \
+                analysis.resolve(second.func, aliases) in _PSPEC_NAMES:
+            return True
+        return any(s in axes for s in self._axis_strings(second))
+
+    def check_module(self, module: SourceModule,
+                     project: Project) -> Iterable[Finding]:
+        if module.relpath.replace(os.sep, "/").endswith(
+                "parallel/rules.py"):
+            return ()
+        aliases = analysis.module_aliases(module)
+        mesh_axes = set(project.mesh_axes())
+        axes = mesh_axes | set(project.logical_axes())
+        out: list[Finding] = []
+        table_lines: set[int] = set()
+        for node in ast.walk(module.tree):
+            # (a) a rule TABLE: >= 2 (name, spec) pairs in one literal
+            if isinstance(node, (ast.Tuple, ast.List)) and \
+                    len(node.elts) >= 2 and all(
+                        self._is_rule_pair(e, axes, aliases)
+                        for e in node.elts):
+                table_lines.add(node.lineno)
+                out.append(self.finding(
+                    module.relpath, node.lineno, node.col_offset,
+                    "hand-wired partition-rule table — spec tables live "
+                    "in parallel/rules.py PARTITION_RULES (one source for "
+                    "engine, ZeRO, checkpoints, auto_layout and "
+                    "shardcheck); matching by name here WILL drift"))
+        for node in ast.walk(module.tree):
+            # (b) a PartitionSpec built from literal MESH axis names —
+            # activation constraints go through logical names + the
+            # registry layout table, params through PARTITION_RULES
+            if not isinstance(node, ast.Call):
+                continue
+            if analysis.resolve(node.func, aliases) not in _PSPEC_NAMES:
+                continue
+            if node.lineno in table_lines:
+                continue  # already reported as part of the table
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            literal = [s for a in args for s in self._axis_strings(a)
+                       if s in mesh_axes]
+            if literal:
+                out.append(self.finding(
+                    module.relpath, node.lineno, node.col_offset,
+                    f"PartitionSpec with literal mesh axes {literal} "
+                    f"outside parallel/rules.py — resolve through the "
+                    f"registry (registry_specs/kv_pool_spec/batch_spec) "
+                    f"so shardcheck can audit it"))
+        return out

@@ -16,6 +16,10 @@ from fleetx_tpu.utils.log import logger
 
 class ErnieModule(BasicModule):
     """ERNIE pretraining task: MLM + NSP losses (reference ernie_module.py)."""
+
+    #: partition-rule registry family (parallel/rules.py)
+    spec_family = "ernie"
+
     def __init__(self, cfg: Any):
         model_cfg = cfg.get("Model", cfg) if isinstance(cfg, dict) else cfg
         self.model_cfg = config_from_dict(dict(model_cfg))

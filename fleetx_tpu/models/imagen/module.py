@@ -21,6 +21,11 @@ from fleetx_tpu.utils.log import logger
 class ImagenModule(BasicModule):
     """Cascade-stage training task."""
 
+    #: partition-rule registry family (parallel/rules.py): the diffusion
+    #: stages are data-parallel only — replication is DECLARED there, not
+    #: an accident of missing rules
+    spec_family = "imagen"
+
     def __init__(self, cfg: Any):
         model_cfg = dict(cfg.get("Model", cfg)) if isinstance(cfg, dict) else {}
         self.model_dict = model_cfg

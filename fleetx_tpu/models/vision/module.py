@@ -22,6 +22,9 @@ from fleetx_tpu.utils.log import logger
 class GeneralClsModule(BasicModule):
     """Generic classification module (reference ``general_classification_module.py``)."""
 
+    #: partition-rule registry family (parallel/rules.py)
+    spec_family = "vision"
+
     def __init__(self, cfg: Any):
         model_cfg = dict(cfg.get("Model", cfg) if isinstance(cfg, dict) else cfg)
         name = model_cfg.get("name", "ViT_base_patch16_224")

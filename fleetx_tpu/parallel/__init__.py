@@ -1,6 +1,16 @@
 from fleetx_tpu.parallel.mesh import MeshEnv, build_mesh, get_mesh, set_mesh  # noqa: F401
+from fleetx_tpu.parallel.rules import (  # noqa: F401
+    MESH_AXES,
+    PARTITION_RULES,
+    SpecLayout,
+    match_partition_rules,
+    named_shardings,
+    registry_fingerprint,
+    registry_specs,
+)
 from fleetx_tpu.parallel.sharding import (  # noqa: F401
     make_axis_rules,
     logical_sharding,
     zero_sharding,
+    zero_grad_specs,
 )

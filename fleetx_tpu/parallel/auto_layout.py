@@ -33,6 +33,7 @@ count from a first-order memory model and TPU cost preferences:
 
 from __future__ import annotations
 
+from fleetx_tpu.parallel.rules import stage_shards
 from fleetx_tpu.utils.log import logger
 
 _MOMENT_BYTES_PER_PARAM = 8.0  # 2 × f32 Adam moments — fsdp shards at stage ≥ 1
@@ -109,12 +110,18 @@ def estimate_step_hbm_bytes(model: dict, micro_batch: int = 1,
 
 def _per_device_bytes(terms: dict, fsdp: int, mp: int, pp: int, seq: int,
                       stage: int) -> float:
-    """Shard the memory terms by what each ZeRO stage actually shards."""
+    """Shard the memory terms by what each ZeRO stage actually shards.
+
+    The stage→term table is the registry's (``parallel/rules.py``
+    ``ZERO_STAGE_TERMS``/``stage_shards``) — the same data that gates the
+    engine's ``zero_sharding``/``zero_grad_specs`` calls, so the memory
+    model and the runtime cannot disagree about what a stage distributes.
+    """
     mpp = max(mp * pp, 1)
-    moments = terms["moments"] / (mpp * (fsdp if stage >= 1 else 1))
-    grads = terms["grads"] / (mpp * (fsdp if stage >= 2 else 1))
-    weights = terms["weights"] / (mpp * (fsdp if stage >= 3 else 1))
-    return moments + grads + weights + terms["act"] / (mpp * max(seq, 1))
+    state = sum(
+        terms[term] / (mpp * (fsdp if stage_shards(term, stage) else 1))
+        for term in ("moments", "grads", "weights"))
+    return state + terms["act"] / (mpp * max(seq, 1))
 
 
 def predicted_step_bytes(model: dict, degrees: dict | None = None,

@@ -45,7 +45,10 @@ from fleetx_tpu.utils.log import logger
 if "JAX_THREEFRY_PARTITIONABLE" not in os.environ:
     jax.config.update("jax_threefry_partitionable", True)
 
-MESH_AXES = ("pipe", "data", "fsdp", "seq", "tensor")
+# the axis vocabulary is DECLARED by the partition-rule registry
+# (parallel/rules.py MESH_AXES — also what FX004 lint parses); the mesh is
+# merely its physical materialisation
+from fleetx_tpu.parallel.rules import MESH_AXES  # noqa: E402
 
 _global_mesh: Mesh | None = None
 

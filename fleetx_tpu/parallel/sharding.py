@@ -9,10 +9,15 @@ names, and one rule table maps logical names to mesh axes.  GSPMD then inserts
 exactly the collectives the reference hand-wires (all-reduce after row-parallel
 matmul, all-gather for sequence parallelism, reduce-scatter for ZeRO grads).
 
-Logical axis vocabulary:
+Since the partition-rule registry landed (``parallel/rules.py``), the rule
+table itself is DATA owned by :class:`~fleetx_tpu.parallel.rules.SpecLayout`
+— this module keeps the runtime faces: ``make_axis_rules`` (the historical
+name every call site and test uses), the flax-context helpers, and the
+ZeRO-1/2/3 placement helpers, whose per-leaf policy is the registry's
+:func:`~fleetx_tpu.parallel.rules.with_fsdp_axis` so the runtime and the
+static shardcheck auditor cannot disagree on where a ZeRO axis lands.
 
-- params: ``vocab, embed, mlp, heads, kv, layers``
-- activations: ``batch, act_seq, act_embed, act_heads``
+Logical axis vocabulary: ``rules.LOGICAL_AXES``.
 """
 
 from __future__ import annotations
@@ -24,6 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import flax.linen as nn
 
+from fleetx_tpu.parallel.rules import SpecLayout, with_fsdp_axis
+
 __all__ = ["make_axis_rules", "logical_sharding", "zero_sharding",
            "zero_grad_specs", "shard_logical"]
 
@@ -31,43 +38,13 @@ __all__ = ["make_axis_rules", "logical_sharding", "zero_sharding",
 def make_axis_rules(dist_config: dict | None = None) -> tuple[tuple[str, Any], ...]:
     """Build logical→mesh axis rules from a ``Distributed`` config section.
 
-    - tensor parallelism: ``vocab/mlp/heads → tensor`` (Megatron column/row
-      splits, reference ``hybrid_model.py:111-119``)
-    - ZeRO stage 3: additionally ``embed → fsdp`` (param sharding, the
-      ``group_sharded_parallel(level="p_g_os")`` analogue)
-    - Megatron-SP (``sequence_parallel: true``): activations sharded
-      ``act_seq → tensor`` (reference ``sequence_parallel_utils.py:150-326``)
-    - context parallelism: ``act_seq → seq`` (ring attention axis — the
-      long-context capability the reference lacks)
+    Thin wrapper over the registry's canonical table
+    (``rules.SpecLayout.axis_rules`` — tensor parallelism via
+    ``vocab/mlp/heads → tensor``, ``embed → fsdp`` at ZeRO stage 3,
+    Megatron-SP's ``act_seq → (seq, tensor)``, ring attention's
+    ``act_seq → seq``); kept as the historical call-site name.
     """
-    cfg = dist_config or {}
-    stage = int((cfg.get("sharding") or {}).get("sharding_stage") or 0)
-    sp = bool(cfg.get("sequence_parallel"))
-
-    act_seq: Any = ("seq", "tensor") if sp else ("seq",)
-    rules: list[tuple[str, Any]] = [
-        ("batch", ("data", "fsdp")),
-        ("vocab", "tensor"),
-        ("mlp", "tensor"),
-        ("heads", "tensor"),
-        ("kv", None),
-        ("layers", None),
-        ("pipe_stage", "pipe"),
-        ("pipe_repeat", None),
-        ("act_stage", "pipe"),
-        ("norm", None),
-        ("embed", "fsdp" if stage >= 3 else None),
-        ("act_seq", act_seq),
-        ("act_embed", None),
-        ("act_heads", "tensor"),
-        ("act_kv", None),
-        ("act_vocab", "tensor"),
-        # expert parallelism (MoE — capability beyond the reference): expert
-        # weights and the dispatched activations shard over the tensor axis
-        ("expert", "tensor"),
-        ("act_expert", "tensor"),
-    ]
-    return tuple(rules)
+    return SpecLayout.from_dist_config(dist_config).axis_rules()
 
 
 def logical_sharding(abstract_tree: Any, mesh: Mesh,
@@ -88,6 +65,23 @@ def shard_logical(x: jax.Array, logical_axes: tuple[str | None, ...],
     return jax.lax.with_sharding_constraint(x, spec)
 
 
+def _fsdp_leaf_fn(mesh: Mesh, axis: str, only_if_replicated: bool):
+    """The ONE ZeRO per-leaf placement closure shared by
+    ``zero_sharding`` (optimizer state, stage 1/2) and ``zero_grad_specs``
+    (gradients, stage 2) — policy lives in ``rules.with_fsdp_axis``."""
+    size = mesh.shape[axis]
+
+    def leaf_spec(leaf: Any, existing: Any = None) -> Any:
+        shape = tuple(getattr(leaf, "shape", ()))
+        spec = tuple(getattr(existing, "spec", P())) if existing is not None \
+            else ()
+        return NamedSharding(mesh, P(*with_fsdp_axis(
+            shape, spec, size, axis=axis,
+            only_if_replicated=only_if_replicated)))
+
+    return leaf_spec
+
+
 def zero_sharding(tree: Any, mesh: Mesh, axis: str = "fsdp",
                   param_shardings: Any = None) -> Any:
     """ZeRO-1/2 optimizer-state sharding over the ``fsdp`` axis.
@@ -99,23 +93,10 @@ def zero_sharding(tree: Any, mesh: Mesh, axis: str = "fsdp",
     dimension (scalars, small vectors) stay replicated.  Leaves that already
     carry a non-replicated param sharding (stage 3 / tensor parallel) keep it.
     """
-    size = mesh.shape[axis]
-
-    def leaf_sharding(leaf: Any, existing: Any = None) -> Any:
-        if existing is not None and any(s is not None for s in getattr(existing, "spec", P())):
-            return existing
-        shape = getattr(leaf, "shape", ())
-        if size > 1:
-            for dim, d in enumerate(shape):
-                if d % size == 0 and d >= size:
-                    spec = [None] * len(shape)
-                    spec[dim] = axis
-                    return NamedSharding(mesh, P(*spec))
-        return NamedSharding(mesh, P())
-
+    leaf_spec = _fsdp_leaf_fn(mesh, axis, only_if_replicated=True)
     if param_shardings is not None:
-        return jax.tree.map(leaf_sharding, tree, param_shardings)
-    return jax.tree.map(leaf_sharding, tree)
+        return jax.tree.map(leaf_spec, tree, param_shardings)
+    return jax.tree.map(leaf_spec, tree)
 
 
 def zero_grad_specs(tree: Any, mesh: Mesh, axis: str = "fsdp",
@@ -135,29 +116,10 @@ def zero_grad_specs(tree: Any, mesh: Mesh, axis: str = "fsdp",
     dims stay where they are) and additionally shard the first
     still-replicated dimension divisible by the ``fsdp`` size.  Leaves with
     no such dimension (scalars, tiny vectors) keep the param spec — GSPMD
-    falls back to the plain allreduce for those few bytes.
+    falls back to the plain allreduce for those few bytes.  Specs are
+    canonical (no trailing ``None``).
     """
-    size = mesh.shape[axis]
-
-    def leaf_spec(leaf: Any, existing: Any = None) -> Any:
-        shape = getattr(leaf, "shape", ())
-        spec = list(getattr(existing, "spec", P())) if existing is not None \
-            else []
-        spec += [None] * (len(shape) - len(spec))
-        used = set()
-        for entry in spec:
-            for a in (entry if isinstance(entry, (tuple, list)) else (entry,)):
-                if a is not None:
-                    used.add(a)
-        if size > 1 and axis not in used:
-            for dim, d in enumerate(shape):
-                if spec[dim] is None and d % size == 0 and d >= size:
-                    spec[dim] = axis
-                    break
-        while spec and spec[-1] is None:  # canonical form, no trailing Nones
-            spec.pop()
-        return NamedSharding(mesh, P(*spec))
-
+    leaf_spec = _fsdp_leaf_fn(mesh, axis, only_if_replicated=False)
     if param_shardings is not None:
         return jax.tree.map(leaf_spec, tree, param_shardings)
     return jax.tree.map(leaf_spec, tree)

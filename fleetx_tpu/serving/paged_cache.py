@@ -54,12 +54,16 @@ def init_pool(cfg: Any, num_pages: int, page_size: int,
 def pool_shardings(mesh: Mesh) -> NamedSharding:
     """The pool's mesh placement: pages over ``fsdp``, heads over ``tensor``.
 
-    Pool dims are ``(layers, pages, page_size, heads, head_dim)`` — the
-    page dim shards over the ZeRO axis (capacity scales with fsdp degree)
-    and the heads dim over the Megatron axis (matching the dense decode
-    cache's ``act_heads → tensor`` rule in ``parallel/sharding.py``).
+    Pool dims are ``(layers, pages, page_size, heads, head_dim)``; the
+    spec is the registry's ``serving_kv`` family rule
+    (``parallel/rules.py:kv_pool_spec``) — the page dim shards over the
+    ZeRO axis (capacity scales with fsdp degree) and the heads dim over
+    the Megatron axis, and shardcheck audits page/head divisibility for
+    every serving config statically.
     """
-    return NamedSharding(mesh, P(None, "fsdp", None, "tensor", None))
+    from fleetx_tpu.parallel.rules import kv_pool_spec
+
+    return NamedSharding(mesh, kv_pool_spec())
 
 
 class PageAllocator:

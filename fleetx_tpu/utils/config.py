@@ -128,7 +128,12 @@ def override_config(config: AttrDict, options: list[str] | None = None) -> AttrD
 # Post-processing: distributed degrees and batch-size derivation
 # ---------------------------------------------------------------------------
 
-MESH_AXES = ("pipe", "data", "fsdp", "seq", "tensor")
+# one axis-name source for config validation, lint and runtime alike.
+# NOTE: this import routes through fleetx_tpu.parallel/__init__ and thus
+# pulls jax — no cost change here (fleetx_tpu.utils already imports jax
+# via env.py), and lint never imports this module (it AST-parses
+# parallel/rules.py instead)
+from fleetx_tpu.parallel.rules import MESH_AXES  # noqa: E402
 
 
 def process_dist_config(config: AttrDict, num_devices: int | None = None) -> AttrDict:

@@ -38,6 +38,8 @@ def test_registry_has_all_rules():
                  "traced-python-branch", "dead-config-key",
                  "collective-under-rank-guard", "unmatched-agreement-pairing",
                  "step-keyed-gang-trigger", "retrace-hazard",
+                 "shard-rule-coverage", "shard-rule-health",
+                 "hand-wired-spec-table",
                  "docstring-missing", "docstring-empty"):
         assert name in rules, name
     codes = [r.code for r in rules.values()]
@@ -662,11 +664,18 @@ def test_write_baseline_refuses_filtered_run(tmp_path):
 
 def test_whole_repo_lint_is_clean():
     """The CI contract: `python tools/lint.py` exits 0 on the tree with
-    EVERY rule enabled — the v2 gang-lockstep rules included — and with
-    zero baseline entries (true positives are fixed, not accepted)."""
+    EVERY rule enabled and zero baseline entries (true positives are
+    fixed, not accepted).
+
+    The eval_shape-driven shardcheck rules (FX011/FX012, category
+    ``shardcheck``) are skipped HERE only to keep this mid-suite test off
+    the tier-1 timeout budget — their whole-zoo gate runs as a subprocess
+    in tests/test_zz_shardcheck.py (zz-sorted last per the gate
+    convention), and the real `python tools/lint.py` CI command runs them
+    with the result cache keyed on registry+config fingerprints."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "lint.py"),
-         "--json", "-"],
+         "--skip", "shardcheck", "--json", "-"],
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0, f"lint found issues:\n{proc.stdout}"
     # stdout carries the JSON payload then the text summary
@@ -674,7 +683,8 @@ def test_whole_repo_lint_is_clean():
     assert payload["clean"] is True
     assert len(payload["rules"]) >= 12
     for name in ("collective-under-rank-guard", "unmatched-agreement-pairing",
-                 "step-keyed-gang-trigger", "retrace-hazard"):
+                 "step-keyed-gang-trigger", "retrace-hazard",
+                 "hand-wired-spec-table"):
         assert name in payload["rules"], name
     assert payload["counts"]["baselined"] == 0
     assert not os.path.exists(

@@ -32,7 +32,9 @@ def test_zero_sharding_picks_divisible_dim(devices8):
                         devices=devices8)
     tree = {"m": jnp.zeros((8, 3)), "v": jnp.zeros((3,)), "count": jnp.zeros(())}
     sh = S.zero_sharding(tree, mesh)
-    assert sh["m"].spec == P("fsdp", None)
+    # canonical no-trailing-None form (parallel/rules.py): same placement
+    # as the historical P("fsdp", None) spelling
+    assert sh["m"].spec == P("fsdp")
     assert sh["v"].spec == P()          # 3 not divisible by 4 → replicated
     assert sh["count"].spec == P()
 

@@ -18,11 +18,16 @@ sites inline with ``# fleetx: noqa[rule-name] -- reason``.
 
 ``--changed-only`` selects files from ``git diff HEAD`` plus untracked
 files.  When only module-scope rules are selected those files alone are
-parsed; when a project-scope rule runs (the FX006-FX009 cross-file
+parsed; when a project-scope rule runs (the FX006-FX012 cross-file
 analyses) the full project is still scanned for context and the *report*
-is restricted to the changed files.  Either way the content-fingerprint
-result cache (``.lint_cache.json``, disable with ``--no-cache``) keeps the
-grown repo's lint in seconds.
+is restricted to the changed files.  A changed file under the YAML config
+zoo (``fleetx_tpu/configs/**``, ``projects/**``) is a PROJECT-scope
+trigger: the full-tree scan runs AND the report is unrestricted, because
+a config edit can create findings in other files entirely (FX006's dead
+keys in code, FX011/FX012 shardcheck findings against
+``parallel/rules.py``).  Either way the content-fingerprint result cache
+(``.lint_cache.json``, disable with ``--no-cache``) keeps the grown
+repo's lint in seconds.
 """
 
 import argparse
@@ -60,6 +65,33 @@ def _changed_files(repo):
         rel for rel in out
         if rel.endswith(_LINTABLE) and os.path.exists(
             os.path.join(repo, rel)))
+
+
+def _config_zoo_changed(changed, config_dirs) -> bool:
+    """True when any changed file lives under the YAML config zoo — a
+    project-scope trigger: FX006 and the shardcheck rules (FX011/FX012)
+    must re-run over the FULL tree with an unrestricted report, because a
+    YAML-only diff can create findings in .py files (dead config keys,
+    dead partition rules, registry coverage gaps)."""
+    prefixes = tuple(d.rstrip("/") + "/" for d in config_dirs)
+    return any(rel.endswith((".yaml", ".yml")) and rel.startswith(prefixes)
+               for rel in changed)
+
+
+def _shardcheck_deps_changed(changed) -> bool:
+    """True when any changed file is in the shardcheck audit's dependency
+    set (the registry, the audit driver, any model definition, …).
+    FX011/FX012 findings are anchored to CONFIG paths, so an edit to
+    models/** or parallel/rules.py that breaks coverage would otherwise be
+    silently dropped by the changed-files report restriction — exactly
+    the drift class shardcheck exists to catch. Such edits lift the
+    restriction like a config-zoo edit does."""
+    from fleetx_tpu.lint.rules.sharding import (_FINGERPRINT_DIRS,
+                                                _FINGERPRINT_FILES)
+
+    prefixes = tuple(d.rstrip("/") + "/" for d in _FINGERPRINT_DIRS)
+    return any(rel in _FINGERPRINT_FILES or rel.startswith(prefixes)
+               for rel in changed)
 
 
 def main(argv=None) -> int:
@@ -129,6 +161,15 @@ def main(argv=None) -> int:
             print("warning: git unavailable — falling back to a full run",
                   file=sys.stderr)
         else:
+            from fleetx_tpu.lint.core import CONFIG_DIRS
+
+            # config-zoo and shardcheck-dependency edits trigger the full
+            # project scan BEFORE the scope filter (projects/** sits
+            # outside the default fleetx_tpu/ scope but is part of the
+            # FX006/shardcheck zoo; model/registry edits create findings
+            # anchored to config paths that a restricted report would drop)
+            config_trigger = _config_zoo_changed(changed, CONFIG_DIRS) or \
+                _shardcheck_deps_changed(changed)
             changed = [rel for rel in changed
                        if any(rel == p or rel.startswith(p.rstrip("/") + "/")
                               for p in scope_prefixes)]
@@ -139,7 +180,12 @@ def main(argv=None) -> int:
             except KeyError as e:
                 print(f"error: {e.args[0]}", file=sys.stderr)
                 return 2
-            if not changed:
+            if config_trigger and any(r.scope == "project"
+                                      for r in selected):
+                print("changed-only: config zoo or shardcheck dependency "
+                      "edited — running the full-tree scan with an "
+                      "unrestricted report", file=sys.stderr)
+            elif not changed:
                 # a clean result through the NORMAL emit path: --json /
                 # --sarif consumers get a fresh (empty) report instead of
                 # silently inheriting a stale file from a previous run

@@ -1,0 +1,149 @@
+"""shardcheck — static sharding audit over the partition-rule registry.
+
+Usage::
+
+    python tools/shardcheck.py --all-configs        # audit the whole zoo
+    python tools/shardcheck.py fleetx_tpu/configs/nlp/gpt/pretrain_gpt_base.yaml
+    python tools/shardcheck.py --all-configs --json -      # machine-readable
+    python tools/shardcheck.py --all-configs --sarif out.sarif
+    python tools/shardcheck.py --selftest-drift     # prove detection works
+
+For every YAML-zoo config this derives the model's abstract parameter
+tree with ``jax.eval_shape`` (shape-level, no FLOPs — runs on CPU CI) and
+verifies it against ``fleetx_tpu/parallel/rules.py``: every leaf matched
+by exactly one rule, no dead rules, sharded dims divisible by their mesh
+degrees, no oversized replicated leaf, and (via FX013 over the source
+tree) no hand-wired spec table outside the registry. Findings are
+reported through the fleetx-lint stack — same text/JSON/SARIF renderers,
+fingerprint baseline and result cache as ``tools/lint.py`` (rules FX011,
+FX012, FX013; docs/static_analysis.md "Shardcheck").
+
+Exit codes follow ``tools/lint.py``: 0 clean, 1 findings, 2 usage error.
+
+``--selftest-drift`` mutates one GPT rule in-process (an unknown logical
+axis) and expects the audit to FAIL naming the leaf — the end-to-end
+proof that a drifted registry cannot pass CI silently.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+DEFAULT_CACHE = os.path.join(REPO_ROOT, ".lint_cache.json")
+
+#: the shardcheck rule set (fleetx_tpu/lint/rules/sharding.py)
+RULES = ("FX011", "FX012", "FX013")
+
+
+def _selftest_drift() -> int:
+    """Corrupt one registry rule in-process and require the audit to
+    refuse it, naming the leaf — exercised by tests/test_zz_shardcheck.py
+    and handy as an operator smoke test after editing the registry."""
+    from fleetx_tpu.parallel import rules as R
+    from fleetx_tpu.parallel import shardcheck as SC
+
+    table = list(R.PARTITION_RULES["gpt"])
+    pattern, _ = table[0]
+    table[0] = (pattern, ("bogus_axis", None, "heads", "kv"))
+    R.PARTITION_RULES["gpt"] = tuple(table)
+    report = SC.audit_zoo(REPO_ROOT)
+    bad = [i for i in report["issues"]
+           if i["kind"] in ("unknown-axis", "rank-mismatch", "unmatched")]
+    if not bad:
+        print("selftest-drift FAILED: mutated rule "
+              f"{pattern!r} was not detected", file=sys.stderr)
+        return 2
+    print(f"selftest-drift OK: mutated rule {pattern!r} detected "
+          f"({len(bad)} finding(s)); first:")
+    first = bad[0]
+    print(f"  {first['config']}: [{first['kind']}] leaf "
+          f"{first['leaf']!r}: {first['message']}")
+    return 1  # a drifted registry MUST be a failing exit
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="static sharding audit over parallel/rules.py")
+    ap.add_argument("configs", nargs="*", default=None,
+                    help="config files to audit (default: the whole zoo)")
+    ap.add_argument("--all-configs", action="store_true",
+                    help="audit every YAML-zoo config (the CI gate mode; "
+                         "also the default when no configs are given)")
+    ap.add_argument("--json", metavar="OUT", nargs="?", const="-",
+                    help="write the report as JSON (- for stdout)")
+    ap.add_argument("--sarif", metavar="OUT",
+                    help="write the report as SARIF 2.1.0")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the result cache (keyed on the registry "
+                         "+ model + config fingerprints)")
+    ap.add_argument("--baseline", metavar="PATH", default=None,
+                    help="lint baseline file (zero entries expected)")
+    ap.add_argument("--selftest-drift", action="store_true",
+                    help="mutate one rule in-process and require the "
+                         "audit to fail naming the leaf")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.selftest_drift:
+        return _selftest_drift()
+    if args.all_configs and args.configs:
+        print("error: pass either --all-configs or explicit config paths,"
+              " not both", file=sys.stderr)
+        return 2
+
+    from fleetx_tpu.lint import render_json, render_sarif, render_text, \
+        run_lint
+    from fleetx_tpu.lint.rules import sharding as sharding_rules
+
+    only = None
+    if args.configs:
+        only = [os.path.relpath(os.path.abspath(c), REPO_ROOT)
+                .replace(os.sep, "/") for c in args.configs]
+        for rel in only:
+            if not os.path.exists(os.path.join(REPO_ROOT, rel)):
+                print(f"error: config not found: {rel}", file=sys.stderr)
+                return 2
+    sharding_rules.set_config_filter(only)
+
+    baseline = args.baseline
+    if baseline is None and os.path.exists(DEFAULT_BASELINE):
+        baseline = DEFAULT_BASELINE
+    try:
+        # fleetx_tpu/ + tools/ + tasks/: FX013's "no hand-wired spec
+        # table outside the registry" guarantee must cover the WHOLE
+        # source tree, not just the package (a literal-axis spec in
+        # tools/serve.py drifts exactly like one in serving/)
+        result = run_lint(
+            [os.path.join(REPO_ROOT, d)
+             for d in ("fleetx_tpu", "tools", "tasks")], root=REPO_ROOT,
+            select=list(RULES), baseline_path=baseline,
+            cache_path=None if args.no_cache else DEFAULT_CACHE)
+    finally:
+        sharding_rules.set_config_filter(None)
+
+    if args.json:
+        payload = json.dumps(render_json(result), indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    if args.sarif:
+        payload = json.dumps(render_sarif(result), indent=1)
+        if args.sarif == "-":
+            print(payload)
+        else:
+            with open(args.sarif, "w") as f:
+                f.write(payload + "\n")
+    print(render_text(result, verbose=args.verbose))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
