@@ -164,6 +164,13 @@ def _bench_impl() -> dict:
     remat_save_dtype = os.environ.get("FLEETX_BENCH_REMAT_SAVE_DTYPE")
     if remat_save_dtype:
         model_kwargs["remat_save_dtype"] = remat_save_dtype
+    # fused single-pass flash backward A/B (docs/bandwidth_levers.md):
+    # force either side; unset keeps the model default (on where the
+    # kernel predicate admits the shape)
+    fused_bwd_env = os.environ.get("FLEETX_BENCH_FUSED_BWD")
+    if fused_bwd_env is not None:
+        model_kwargs["flash_fused_bwd"] = \
+            fused_bwd_env.lower() not in ("0", "false", "")
     cfg = {
         "Model": dict(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=layers,
                       num_attention_heads=16, ffn_hidden_size=4096,
@@ -341,6 +348,24 @@ def _bench_impl() -> dict:
         result["fit_error"] = fit_error
     if remat_save_dtype:
         result["remat_save_dtype"] = remat_save_dtype
+    # which backward the flash kernel compiled: the config knob AND the
+    # kernel predicate for this config's attention shape — a shape the
+    # predicate rejects reports False even with the knob on, so the
+    # gpt_fusedbwd A/B and the flash_bwd_passes row can never contradict
+    try:
+        import jax.numpy as jnp
+
+        from fleetx_tpu.ops import flash_attention as fa
+
+        mc = module.model_cfg
+        q_abs = jax.ShapeDtypeStruct(
+            (bsz, seq, mc.num_attention_heads, mc.head_dim), jnp.bfloat16)
+        result["flash_fused_bwd"] = bool(
+            getattr(mc, "flash_fused_bwd", False)
+            and fa.supported(q_abs, q_abs)
+            and fa.fused_backward_supported(q_abs, q_abs))
+    except Exception as e:
+        result["flash_fused_bwd"] = f"error: {type(e).__name__}: {e}"[:120]
 
     # HBM attribution (docs/performance.md): measured peak vs auto_layout's
     # prediction for this exact config; "unavailable" is the explicit
@@ -372,6 +397,16 @@ def _bench_impl() -> dict:
                 trace_dir, flops_per_step=flops,
                 roofline=roofline(getattr(dev, "device_kind", "")))
             result["decomposition"] = perf_mod.summary(rep)
+            # headline rows for tools/perf_gate.py: backward flash kernel
+            # passes per layer (1 fused vs 3 split — exact-match gated)
+            # and the backward scan's per-layer time under the gauge name
+            # the engine's perf stream uses
+            passes = result["decomposition"].get("bwd_flash_passes_per_layer")
+            if passes is not None:
+                result["flash_bwd_passes"] = passes
+            bwd_ms = result["decomposition"].get("bwd_scan_ms_per_layer")
+            if bwd_ms is not None:
+                result["perf_bwd_ms_per_layer"] = bwd_ms
         except Exception as e:
             result["decomposition_error"] = \
                 f"{type(e).__name__}: {e}"[:200]

@@ -73,6 +73,17 @@ class GPTConfig:
     # stay inert instead of quantising the forward for no saving
     # (_residual_casts_active).
     remat_save_dtype: Any = None
+    # write remat-saved residuals in their CONSUMED layout
+    # (docs/bandwidth_levers.md): transpose the named saved values at the
+    # save point so the scan-stacked buffer is laid out the way the
+    # backward reads it (res_qkv: [b,3,s,n,d] -> [3,b,s,n,d], making the
+    # q/k/v split contiguous leading slices instead of strided mid-axis
+    # copies) and re-constrain the stacked values so GSPMD cannot
+    # re-introduce the copy. Exact math — only layout changes. Same
+    # activation gate as remat_save_dtype (use_recompute + "dots" on
+    # dense stacks); the two compose into ONE save-point transform
+    # pipeline (_save_residual).
+    remat_consumed_layout: bool = True
     # dtype of the gradient-accumulation scan carry (docs/zero_sharding.md):
     # fp32 (default) accumulates microbatch grads in full precision
     # regardless of the compute dtype; bfloat16 opt-in halves the
@@ -81,6 +92,13 @@ class GPTConfig:
     # the grads' native dtype (legacy behaviour).
     grad_accum_dtype: Any = jnp.float32
     use_flash_attention: bool = True
+    # single-pass fused flash backward (ops/flash_attention.py): one Pallas
+    # kernel sweeps the (q-block, k-block) tiles once and emits dq/dk/dv
+    # together — 1 backward kernel pass where the split dq + dkv pair paid
+    # 3 in the committed trace (flash_recompute, BENCHMARKS.md). Applies
+    # only where fused_backward_supported admits the shape; other shapes
+    # (wide heads, non-tiling seqs) keep the split kernels regardless.
+    flash_fused_bwd: bool = True
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
     sequence_parallel: bool = False
     use_ring_attention: bool = False  # context parallelism over the seq axis
@@ -133,44 +151,107 @@ def _flash_residuals_saveable(prim, *_, **__) -> bool:
 #: ``save_only_these_names`` policy keys on exactly this set
 RESIDUAL_NAMES = ("res_qkv", "res_attn_out", "res_mlp_wi", "res_mlp_wo")
 
+#: consumed-layout transposes (docs/bandwidth_levers.md): per residual
+#: name, the permutation applied at the SAVE point so the scan-stacked
+#: buffer is written the way the backward reads it. Only ``res_qkv`` needs
+#: one — [b, 3, s, n, d] → [3, b, s, n, d] makes the backward's q/k/v
+#: split three contiguous leading slices (XLA folds the replayed inverse
+#: transpose + slice into a plain slice) where the stock layout forces a
+#: strided mid-axis gather per layer — the dus_traffic copy the trace
+#: decomposition names. The other three residuals are already produced in
+#: the layout their consuming matmuls read ([b, s, features], contracted
+#: over the trailing dim), so their transform is identity.
+RESIDUAL_CONSUMED_PERMS: dict[str, tuple[int, ...]] = {
+    "res_qkv": (1, 0, 2, 3, 4),
+}
 
-def _residual_casts_active(cfg: GPTConfig) -> bool:
-    """True when the named residual casts actually buy saved bytes: the
-    "dots" policy is the only consumer of the names, so outside
-    use_recompute+dots the cast would quantise the forward for zero
+#: logical specs re-constraining the saved (consumed-layout) values: the
+#: scan stacks them into [layers, ...] buffers, and without an explicit
+#: constraint GSPMD may re-shard the stacked buffer between the forward
+#: write and the backward read — re-introducing exactly the copy the
+#: transpose removed. Specs mirror the activation constraints the forward
+#: applies after each save point.
+RESIDUAL_CONSUMED_SPECS: dict[str, tuple] = {
+    "res_qkv": (None, "batch", "act_seq", "act_heads", "act_kv"),
+    "res_attn_out": ("batch", "act_seq", "act_embed"),
+    "res_mlp_wi": ("batch", "act_seq", "mlp"),
+    "res_mlp_wo": ("batch", "act_seq", "act_embed"),
+}
+
+
+def _transform_gate_active(cfg: GPTConfig) -> bool:
+    """Shared activation gate for BOTH save-point transforms: the "dots"
+    policy is the only consumer of the residual names, so outside
+    use_recompute+dots the transforms would alter the forward for zero
     benefit; MoE stacks don't carry the names (MoEMlp's expert matmuls
     would silently lose their saveability under a names-only policy), so
-    the diet stays off there too."""
-    return (cfg.remat_save_dtype is not None and cfg.use_recompute
-            and cfg.recompute_granularity == "dots"
+    both levers stay off there too."""
+    return (cfg.use_recompute and cfg.recompute_granularity == "dots"
             and cfg.moe_num_experts == 0)
 
 
-def _save_residual(x: jax.Array, name: str, cfg: GPTConfig) -> jax.Array:
-    """Route a remat-saveable intermediate through a named dtype cast.
+def _residual_casts_active(cfg: GPTConfig) -> bool:
+    """True when the named residual casts actually buy saved bytes."""
+    return cfg.remat_save_dtype is not None and _transform_gate_active(cfg)
 
-    When the casts are active (``_residual_casts_active``), the value is
-    cast down, tagged with ``checkpoint_name`` (so
-    ``save_only_these_names`` saves the CAST copy), and cast back for the
-    ongoing forward compute — the backward replays only the upcast from
-    the saved low-precision residual. The round-trip deliberately
-    quantises the forward too: saved-vs-recomputed values must agree or
-    the gradients would be inconsistent across the remat boundary.
+
+def _residual_layouts_active(cfg: GPTConfig) -> bool:
+    """True when the consumed-layout transposes apply (exact math — the
+    gate exists so the inert configs keep a byte-identical program)."""
+    return cfg.remat_consumed_layout and _transform_gate_active(cfg)
+
+
+def _residual_transforms_active(cfg: GPTConfig) -> bool:
+    """Either save-point transform on → the names-keyed policy applies."""
+    return _residual_casts_active(cfg) or _residual_layouts_active(cfg)
+
+
+def _save_residual(x: jax.Array, name: str, cfg: GPTConfig) -> jax.Array:
+    """Route a remat-saveable intermediate through the save-point
+    transform pipeline: consumed-layout transpose → dtype cast → sharding
+    constraint → ``checkpoint_name`` tag → inverse cast/transpose.
+
+    One pipeline serves both levers (docs/bandwidth_levers.md): with the
+    casts active (``_residual_casts_active``) the tagged value is the
+    low-precision copy (``save_only_these_names`` saves it; the backward
+    replays only the upcast) — the round-trip deliberately quantises the
+    forward too, since saved-vs-recomputed values must agree across the
+    remat boundary. With the layouts active
+    (``_residual_layouts_active``) the tagged value is additionally
+    transposed into its consumed layout and re-constrained, so the scan
+    writes the stacked buffer the way the backward reads it; the forward
+    continues from the inverse transpose (exact, layout-only).
     """
-    if not _residual_casts_active(cfg):
+    casts = _residual_casts_active(cfg)
+    layouts = _residual_layouts_active(cfg)
+    if not casts and not layouts:
         return x
     orig = x.dtype
-    return checkpoint_name(
-        x.astype(cfg.remat_save_dtype), name).astype(orig)
+    perm = RESIDUAL_CONSUMED_PERMS.get(name) if layouts else None
+    y = jnp.transpose(x, perm) if perm is not None else x
+    if casts:
+        y = y.astype(cfg.remat_save_dtype)
+    if layouts:
+        spec = RESIDUAL_CONSUMED_SPECS.get(name)
+        if spec is not None and len(spec) == y.ndim:
+            y = with_logical(y, spec)
+    y = checkpoint_name(y, name).astype(orig)
+    if perm is not None:
+        inv = [0] * len(perm)
+        for i, p in enumerate(perm):
+            inv[p] = i
+        y = jnp.transpose(y, tuple(inv))
+    return y
 
 
 def _dots_policy(cfg: GPTConfig):
     """The "dots" remat policy: matmul outputs + flash residuals.
 
-    With the residual casts active, the matmul outputs are saved through
-    their named casts (``_save_residual``) INSTEAD of the raw dot outputs —
-    same remat structure, half the stacked-residual bytes at bf16."""
-    if _residual_casts_active(cfg):
+    With either save-point transform active, the matmul outputs are saved
+    through their named transformed copies (``_save_residual``) INSTEAD of
+    the raw dot outputs — same remat structure, consumed-layout stacks
+    and/or half the stacked-residual bytes at bf16."""
+    if _residual_transforms_active(cfg):
         dots = jax.checkpoint_policies.save_only_these_names(*RESIDUAL_NAMES)
     else:
         dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
@@ -322,7 +403,7 @@ class MultiHeadAttention(nn.Module):
             rate = 0.0 if deterministic else cfg.attention_probs_dropout_prob
             if flash_attention.supported(q, k) and (
                     rate == 0.0 or flash_attention.dropout_supported()):
-                kwargs = dict(causal=True)
+                kwargs = dict(causal=True, fused_bwd=cfg.flash_fused_bwd)
                 if rate > 0.0:
                     # in-kernel dropout: per-layer seed from the dropout rng
                     seed = jax.random.randint(

@@ -514,4 +514,10 @@ def summary(report: dict) -> dict:
         ph = phases.get(label)
         if ph and ph.get("ms_per_layer") is not None:
             out[f"{label}_ms_per_layer"] = ph["ms_per_layer"]
+    # backward flash kernel passes per layer: the fused-backward A/B's
+    # mechanized evidence (1 fused vs 3 split; bench.py promotes it to
+    # the flash_bwd_passes row tools/perf_gate.py exact-matches)
+    bwd = phases.get("bwd_scan") or {}
+    if bwd.get("flash_passes_per_layer") is not None:
+        out["bwd_flash_passes_per_layer"] = bwd["flash_passes_per_layer"]
     return out

@@ -7,8 +7,19 @@ never materialises the [S, S] score matrix in HBM:
 
 - forward: one pass over K/V blocks per Q block, f32 accumulators in VMEM,
   causal blocks skipped entirely (2x FLOP saving);
-- backward: FlashAttention-2 style — a dq kernel and a dk/dv kernel that
-  recompute P from the saved logsumexp, so residual memory is O(S) not O(S^2).
+- backward, fused (default where ``fused_backward_supported``): ONE kernel
+  sweeps the (k-block, q-block) tile grid once, recomputes P once per tile,
+  and emits dq, dk and dv together — dq accumulates in its full-sequence
+  f32 output window (VMEM-resident per head, one HBM writeback), dk/dv in
+  per-block scratch over the minor (q) dimension. The committed trace paid
+  3 backward kernel passes per layer (dq + dkv each re-reading q/k/v/do and
+  recomputing P); the fused sweep pays 1 (``flash_recompute`` + a share of
+  the HBM re-reads in the BENCHMARKS.md decomposition).
+- backward, split (fallback): FlashAttention-2 style — a dq kernel and a
+  dk/dv kernel that recompute P from the saved logsumexp, so residual memory
+  is O(S) not O(S^2). Selected when the fused predicate rejects the shape
+  (wide heads, non-tiling or very long sequences) or via
+  ``fused_bwd=False`` (``Model.flash_fused_bwd``).
 
 Layout contract: q, k, v are [batch, seq, heads, head_dim] (the model's
 ``bsnd``); internally reshaped to [batch*heads, seq, head_dim].
@@ -99,6 +110,35 @@ def supported(q: jax.Array, k: jax.Array | None = None,
     elif block_k is not None and seq % min(seq, block_k):
         return False
     return head_dim in (64, 128, 256)
+
+
+#: VMEM budget for the fused backward's full-sequence f32 dq accumulator
+#: window (plus the two per-block dk/dv scratches). 4 MiB leaves the
+#: q/k/v/do blocks, the f32 score tile and Mosaic's double buffering
+#: comfortable headroom under the ~16 MB core budget: seq 16384 at
+#: head_dim 64, 8192 at 128.
+_FUSED_DQ_SCRATCH_BYTES = 4 * 1024 * 1024
+
+
+def fused_backward_supported(q: jax.Array, k: jax.Array | None = None,
+                             block_q: int | None = None,
+                             block_k: int | None = None,
+                             causal: bool = True) -> bool:
+    """True when the single-pass fused backward kernel applies: the base
+    ``supported`` contract, a non-wide head (>128 degrades to the split
+    kernels — their per-block scratch stays bounded where the fused dq
+    accumulator would not), and the full-sequence f32 dq window within
+    ``_FUSED_DQ_SCRATCH_BYTES``. Shapes this rejects fall back to the
+    split dq + dkv kernels — today's behavior, never silence."""
+    if not supported(q, k, block_q=block_q, block_k=block_k, causal=causal):
+        return False
+    seq, head_dim = q.shape[1], q.shape[3]
+    if head_dim > 128:
+        return False
+    sk = k.shape[1] if k is not None else seq
+    bk = pick_block(sk, head_dim) if block_k is None else min(block_k, sk)
+    scratch = (seq + 2 * bk) * head_dim * 4
+    return scratch <= _FUSED_DQ_SCRATCH_BYTES
 
 
 # ---------------------------------------------------------------------------
@@ -388,7 +428,131 @@ def _bwd_dkv(q3, k3, v3, do, lse3, delta3, seed, *, scale, causal,
     )(q3, k3, v3, do, lse3, delta3, seed)
 
 
-def _bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      seed_ref, dq_ref, dk_ref, dv_ref,
+                      dk_acc, dv_acc, *, scale, causal,
+                      block_q, block_k, dropout_rate):
+    """Single-pass fused backward: grid (head, k-block, q-block).
+
+    Each tile recomputes P exactly once and contributes to all three
+    grads. dk/dv accumulate in per-block f32 scratch across the minor
+    (q) dimension — the split dkv kernel's proven shape, one HBM
+    writeback per k-block — and dq accumulates DIRECTLY in its
+    full-sequence f32 output window, whose index map depends only on the
+    head: Mosaic keeps the window VMEM-resident across the entire
+    (k-block, q-block) sweep (the standard reduction idiom — out index
+    invariant over the reduction dims) and flushes it to HBM exactly
+    once, at the head transition. No per-step garbage flushes, no
+    cross-step read-modify-write of HBM-backed blocks.
+    """
+    h = pl.program_id(0)
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nk = pl.num_programs(1)
+    nq = pl.num_programs(2)
+
+    @pl.when((kj == 0) & (qi == 0))
+    def _init_dq():  # fresh head: zero the resident full-seq dq window
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    @pl.when(qi == 0)
+    def _init_dkv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])  # [bq, bk]; lse block [bq, 1] broadcasts
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # identical (h, qi, kj) seeding as the forward mask; this
+            # kernel's grid is (h, kj, qi) so the q/k block counts swap
+            keep = _dropout_mask(seed_ref, h, qi, kj, nq, nk, p.shape,
+                                 dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            dv_acc[:] += jax.lax.dot_general(
+                jnp.where(keep, p * inv, 0.0), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dq_ref[0, pl.ds(q_start, block_q), :] += jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _flush_dkv():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused(q3, k3, v3, do, lse3, delta3, seed, *, scale, causal,
+               block_q, block_k, dropout_rate: float = 0.0):
+    """Fused dq/dk/dv kernel entry (same lse3/delta3 contract as the split
+    kernels: ``[bn, sq, 1]``). dq comes back f32 — it IS the in-kernel
+    accumulator (see ``_bwd_fused_kernel``) — and is cast to the operand
+    dtype outside the kernel."""
+    bn, sq, d = q3.shape
+    sk = k3.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    dq32, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, dropout_rate=dropout_rate),
+        grid=(bn, sk // bk, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            # dq: the whole head's [sq, d] as ONE window, index map
+            # invariant over both sweep dims — resident in VMEM for the
+            # head's entire tile sweep, flushed once at the head change
+            pl.BlockSpec((1, sq, d), lambda h, j, i: (h, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bn, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bn, sk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            _VMEM((bk, d), jnp.float32),
+            _VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do, lse3, delta3, seed)
+    return dq32.astype(q3.dtype), dk, dv
+
+
+def _bwd(scale, causal, block_q, block_k, dropout_rate, fused_bwd,
+         residuals, g):
     q3, k3, v3, seed, out, lse = residuals
     do = g
     delta = (out.astype(jnp.float32) * do.astype(jnp.float32)).sum(axis=-1)
@@ -397,19 +561,24 @@ def _bwd(scale, causal, block_q, block_k, dropout_rate, residuals, g):
     delta3 = delta[..., None]
     kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
               dropout_rate=dropout_rate)
-    dq = _bwd_dq(q3, k3, v3, do, lse3, delta3, seed, **kw)
-    dk, dv = _bwd_dkv(q3, k3, v3, do, lse3, delta3, seed, **kw)
+    if fused_bwd:
+        dq, dk, dv = _bwd_fused(q3, k3, v3, do, lse3, delta3, seed, **kw)
+    else:
+        dq = _bwd_dq(q3, k3, v3, do, lse3, delta3, seed, **kw)
+        dk, dv = _bwd_dkv(q3, k3, v3, do, lse3, delta3, seed, **kw)
     return dq, dk, dv, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash3(q3, k3, v3, seed, scale, causal, block_q, block_k, dropout_rate):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash3(q3, k3, v3, seed, scale, causal, block_q, block_k, dropout_rate,
+            fused_bwd):
     out, _ = _fwd(q3, k3, v3, seed, scale=scale, causal=causal,
                   block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
     return out
 
 
-def _flash3_fwd(q3, k3, v3, seed, scale, causal, block_q, block_k, dropout_rate):
+def _flash3_fwd(q3, k3, v3, seed, scale, causal, block_q, block_k,
+                dropout_rate, fused_bwd):
     out, lse = _fwd(q3, k3, v3, seed, scale=scale, causal=causal,
                     block_q=block_q, block_k=block_k, dropout_rate=dropout_rate)
     return out, (q3, k3, v3, seed, out, lse)
@@ -423,13 +592,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     block_q: int | None = None,
                     block_k: int | None = None,
                     dropout_rate: float = 0.0,
-                    dropout_seed: jax.Array | None = None) -> jax.Array:
+                    dropout_seed: jax.Array | None = None,
+                    fused_bwd: bool = True) -> jax.Array:
     """Blockwise causal attention. q/k/v: [batch, seq, heads, head_dim].
 
     ``dropout_rate`` > 0 applies attention-probability dropout INSIDE the
     kernel (regenerable per-block masks; see ``_dropout_mask``) so training
     configs with attention dropout keep the O(S) memory profile.
     ``dropout_seed``: int32 scalar/[1] array; vary per step.
+    ``fused_bwd`` selects the single-pass fused backward kernel where
+    ``fused_backward_supported`` admits the shape (``Model.flash_fused_bwd``
+    upstream); other shapes — and ``fused_bwd=False`` — take the split
+    dq + dkv kernels.
     """
     b, sq, n, d = q.shape
     sk = k.shape[1]
@@ -458,8 +632,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     def to3(x, s):
         return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
 
+    use_fused = bool(fused_bwd) and fused_backward_supported(
+        q, k, block_q=block_q, block_k=block_k, causal=causal)
     out3 = _flash3(to3(q, sq), to3(k, sk), to3(v, sk), seed, scale, causal,
-                   block_q, block_k, float(dropout_rate))
+                   block_q, block_k, float(dropout_rate), use_fused)
     return out3.reshape(b, n, sq, d).transpose(0, 2, 1, 3)
 
 

@@ -16,6 +16,14 @@ from fleetx_tpu.parallel.mesh import build_mesh
 pytestmark = pytest.mark.skipif(fa.pltpu is None,
                                 reason="pallas tpu module unavailable")
 
+# the sharded wrapper builds a partial-manual jax.shard_map, promoted to
+# the public namespace after this build's 0.4.x line; the fallback and
+# mesh-gating tests below don't reach it and keep running
+_requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="this jax build lacks jax.shard_map (flash_attention_sharded's "
+           "partial-manual partition needs it)")
+
 
 def _qkv(b=4, s=256, n=4, d=64, seed=0):
     rng = np.random.RandomState(seed)
@@ -23,6 +31,7 @@ def _qkv(b=4, s=256, n=4, d=64, seed=0):
     return mk(), mk(), mk()
 
 
+@_requires_shard_map
 def test_sharded_matches_reference_dp_tp(devices8):
     q, k, v = _qkv()
     assert fa.supported(q, k)
@@ -38,6 +47,7 @@ def test_sharded_matches_reference_dp_tp(devices8):
                                rtol=2e-3, atol=2e-3)
 
 
+@_requires_shard_map
 def test_sharded_gradients_match(devices8):
     q, k, v = _qkv(b=2, s=256, n=2, d=64, seed=1)
 

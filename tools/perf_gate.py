@@ -41,9 +41,11 @@ DEFAULT_BASELINE = os.path.join(REPO, "BENCH_SELF.json")
 
 #: metric → (direction, relative tolerance, absolute floor).
 #: direction "higher" = larger is better (regression when fresh drops
-#: below base×(1−tol)); "lower" = smaller is better. The absolute floor
-#: is in the metric's own unit and wins for tiny baselines where a
-#: relative band is all jitter.
+#: below base×(1−tol)); "lower" = smaller is better; "exact" = ANY change
+#: is a regression (structural counts like kernel passes — a half-pass
+#: drift means the compiled program changed shape, not that it got
+#: noisy). The absolute floor is in the metric's own unit and wins for
+#: tiny baselines where a relative band is all jitter.
 GATE_METRICS = {
     "value": ("higher", 0.05, 0.0),            # tokens/s (the headline)
     "mfu": ("higher", 0.05, 0.0),
@@ -52,6 +54,12 @@ GATE_METRICS = {
     "data_stall_frac": ("lower", 0.0, 0.05),   # abs band: baseline ~0
     "hbm_peak_bytes": ("lower", 0.10, 0.0),
     "hbm_model_error": ("lower", 0.0, 0.10),   # abs: it's already relative
+    # fused-backward evidence (docs/bandwidth_levers.md): the backward
+    # scan's per-layer time (same band as the decomposition row it
+    # mirrors) and the backward flash kernel pass count — 1 fused vs 3
+    # split, exact-matched. Both skip when absent (pre-PR-13 baselines).
+    "perf_bwd_ms_per_layer": ("lower", 0.10, 0.05),
+    "flash_bwd_passes": ("exact", 0.0, 0.0),
 }
 #: per-phase span means are noisier than the headline (host scheduling):
 #: wide relative band + a 0.5 ms absolute floor
@@ -120,8 +128,11 @@ def compare(fresh: dict, base: dict,
             continue
         band = max(abs(b) * rel, floor)
         delta = f - b
-        regressed = (delta < -band) if direction == "higher" \
-            else (delta > band)
+        if direction == "exact":
+            regressed = delta != 0
+        else:
+            regressed = (delta < -band) if direction == "higher" \
+                else (delta > band)
         rows.append({
             "metric": metric, "base": b, "fresh": f,
             "delta": round(delta, 6),
@@ -213,6 +224,24 @@ def self_check(baseline_entry: dict) -> list[str]:
     if not any(r["metric"] == "value" and r["verdict"] == "FAIL"
                for r in rows):
         problems.append("synthetic 10% tokens/s regression NOT caught")
+    # the fused-backward rows self-check on synthetic values even when the
+    # committed baseline predates them (their real rows skip-if-absent):
+    # a pass-count change must exact-match FAIL, a 20% backward-per-layer
+    # slowdown must exceed its band, and identical copies must pass
+    seeded = dict(baseline_entry)
+    seeded["flash_bwd_passes"] = 1
+    seeded["perf_bwd_ms_per_layer"] = 5.0
+    rows = compare(dict(seeded), seeded)
+    if any(r["verdict"] == "FAIL" for r in rows):
+        problems.append("identical fused-backward rows flagged as regression")
+    drifted = dict(seeded)
+    drifted["flash_bwd_passes"] = 3
+    drifted["perf_bwd_ms_per_layer"] = 6.0
+    rows = compare(drifted, seeded)
+    for metric in ("flash_bwd_passes", "perf_bwd_ms_per_layer"):
+        if not any(r["metric"] == metric and r["verdict"] == "FAIL"
+                   for r in rows):
+            problems.append(f"synthetic {metric} regression NOT caught")
     return problems
 
 

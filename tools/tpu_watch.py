@@ -316,8 +316,17 @@ def _traced_sweep(state: dict, key: str, variants) -> None:
         res["traced"] = {k: tres[k] for k in
                          ("value", "step_time_s", "decomposition",
                           "decomposition_error", "hbm_stats",
-                          "hbm_peak_bytes", "hbm_model_error")
+                          "hbm_peak_bytes", "hbm_model_error",
+                          "flash_fused_bwd", "flash_bwd_passes",
+                          "perf_bwd_ms_per_layer")
                          if k in tres}
+        # promote the fused-backward gate rows to the ENTRY's top level:
+        # tools/perf_gate.py looks metrics up by top-level dotted path in
+        # the baseline entry, so values left only under "traced" would
+        # make the exact-match flash_bwd_passes row skip forever
+        for key_name in ("flash_bwd_passes", "perf_bwd_ms_per_layer"):
+            if key_name in tres and key_name not in res:
+                res[key_name] = tres[key_name]
         res["_trace_dir"] = trace_dir
     else:
         log(f"{key}: traced re-run failed: {err or 'cpu fallback'}")
@@ -461,6 +470,26 @@ def _capture_gpt_zero2(state: dict) -> None:
                          "FLEETX_BENCH_ZERO_STAGE": "2"}, {})])
 
 
+def _capture_gpt_fusedbwd(state: dict) -> None:
+    """Fused single-pass flash backward A/B (docs/bandwidth_levers.md):
+    same config as gpt_policyfix with FLEETX_BENCH_FUSED_BWD forcing each
+    side — fused sweeps the (q-block, k-block) tiles ONCE and emits
+    dq/dk/dv together, split pays the dq + dkv pair (3 backward kernel
+    passes in the committed trace, flash_recompute 22.5 ms/step). The
+    untraced sweep keeps the faster side; the traced re-run's
+    decomposition carries flash_bwd_passes (1 fused vs 3 split) so the
+    pass-count claim is verifiable from the report alone, and
+    tools/perf_gate.py exact-matches it thereafter. Read against
+    gpt_policyfix. Traced (PR 10)."""
+    _traced_sweep(state, "gpt_fusedbwd",
+                  [("_fused", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                               "FLEETX_BENCH_FUSED_BWD": "1"},
+                    {"flash_fused_bwd": True}),
+                   ("_split", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                               "FLEETX_BENCH_FUSED_BWD": "0"},
+                    {"flash_fused_bwd": False})])
+
+
 CAPTURES = [
     ("gpt", _capture_gpt),
     ("gpt_trace", _capture_gpt_trace),
@@ -473,6 +502,7 @@ CAPTURES = [
     ("gpt_unroll", _capture_gpt_unroll),
     ("gpt_bf16res", _capture_gpt_bf16res),
     ("gpt_zero2", _capture_gpt_zero2),
+    ("gpt_fusedbwd", _capture_gpt_fusedbwd),
     ("imagen", _capture_imagen),
 ]
 
