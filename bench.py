@@ -411,6 +411,18 @@ def _bench_impl() -> dict:
             result["decomposition_error"] = \
                 f"{type(e).__name__}: {e}"[:200]
 
+    # fine-tune micro-bench (docs/finetune.md): adapter step time +
+    # trainable fraction + artifact bytes, gated by perf_gate's
+    # FINETUNE_METRICS. Same phase-isolation stance as the HBM/trace
+    # blocks: a failure here must never cost the measured throughput.
+    # FLEETX_BENCH_FINETUNE=0 skips the phase (it compiles a second,
+    # small program).
+    if os.environ.get("FLEETX_BENCH_FINETUNE", "1") not in ("0", "false"):
+        try:
+            result["finetune"] = _finetune_bench()
+        except Exception as e:
+            result["finetune_error"] = f"{type(e).__name__}: {e}"[:200]
+
     from fleetx_tpu.utils.hardware import gpt_flops_per_token, peak_flops
 
     peak = peak_flops(dev)
@@ -421,6 +433,88 @@ def _bench_impl() -> dict:
                                     num_params=n_params) * bsz * seq
         result["mfu"] = round(flops / dt / (peak * jax.device_count()), 4)
     return result
+
+
+def _finetune_bench() -> dict:
+    """LoRA fine-tune micro-bench (docs/finetune.md): a small fixed-shape
+    GPT with injected adapters under the masked optimizer — deliberately
+    NOT the headline config, so the phase costs seconds on any backend.
+    Emits the three gated keys (tools/perf_gate.py FINETUNE_METRICS):
+    the adapter train-step time, the trainable-fraction gauge (exact-
+    matched — it is a deterministic ratio of this config) and the
+    adapter-only artifact's payload bytes, plus the bytes-vs-base ratio
+    the <5% acceptance bound reads."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from fleetx_tpu.core.engine import EagerEngine
+    from fleetx_tpu.finetune import checkpoint as ft_ckpt
+    from fleetx_tpu.finetune import lora
+    from fleetx_tpu.finetune.module import LoRAGPTModule
+    from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
+    from fleetx_tpu.optims.optimizer import build_optimizer
+
+    bsz = max(2 * jax.device_count(), 4)
+    seq, rank, alpha = 128, 8, 16.0
+    cfg = {
+        "Model": dict(vocab_size=8192, hidden_size=256, num_layers=4,
+                      num_attention_heads=8, max_position_embeddings=seq,
+                      use_flash_attention=False,
+                      module="LoRAGPTModule"),
+        "FineTune": {"lora": {"rank": rank, "alpha": alpha}},
+        "Engine": {"max_steps": 10_000, "logging_freq": 100},
+        "Global": {"seed": 0},
+    }
+    module = LoRAGPTModule(cfg)
+    lr = build_lr_scheduler({"max_lr": 1e-4, "warmup_steps": 10,
+                             "decay_steps": 100})
+    opt = lora.lora_optimizer(build_optimizer({"name": "AdamW"}, lr))
+    engine = EagerEngine(cfg, module, optimizer=opt, lr_schedule=lr)
+    rng = np.random.RandomState(1)
+    tokens = rng.randint(0, 8192, size=(bsz, seq + 1)).astype(np.int32)
+    batch = {"tokens": tokens[:, :-1],
+             "position_ids": np.broadcast_to(
+                 np.arange(seq, dtype=np.int32), (bsz, seq)).copy(),
+             "labels": tokens[:, 1:],
+             "loss_mask": np.ones((bsz, seq), np.float32)}
+    engine.prepare(batch)
+    sharded = engine.shard_batch(batch)
+    with engine._ctx():
+        for _ in range(2):  # compile + warm
+            engine.state, metrics = engine._train_step(engine.state,
+                                                       sharded)
+        jax.block_until_ready(metrics["loss"])
+        n_steps = 5
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            engine.state, metrics = engine._train_step(engine.state,
+                                                       sharded)
+        jax.block_until_ready(metrics["loss"])
+        dt = (time.perf_counter() - t0) / n_steps
+    frac = lora.trainable_params_frac(engine.state.params)
+    tmp = tempfile.mkdtemp(prefix="fleetx_ft_bench_")
+    try:
+        path = ft_ckpt.save_adapter(tmp, 0, engine.state.params,
+                                    base_dir=None, rank=rank, alpha=alpha)
+        adapter_nbytes = ft_ckpt.adapter_bytes(path)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    # actual bytes of the BASE tree only (adapters excluded, real dtype
+    # widths) — the denominator the <5% acceptance bound compares against
+    base_tree, _ = lora.split_adapters(engine.state.params)
+    base_bytes = sum(int(leaf.nbytes)
+                     for leaf in jax.tree.leaves(base_tree))
+    return {
+        "adapter_step_time_s": round(dt, 5),
+        "trainable_params_frac": round(frac, 6),
+        "adapter_ckpt_bytes": int(adapter_nbytes),
+        "adapter_bytes_vs_base": round(adapter_nbytes
+                                       / max(base_bytes, 1), 5),
+        "batch_size": bsz,
+        "lora_rank": rank,
+    }
 
 
 def _run_child(extra_env: dict, timeout: float = 1200.0,

@@ -1,0 +1,26 @@
+"""Parameter-efficient fine-tuning subsystem (docs/finetune.md).
+
+LoRA adapters from pretrain checkpoint to quantized serving: adapter
+injection over registry-named target matmuls (``lora.py``), the
+``LoRAGPTModule`` task recipe (``module.py``), the verified adapter-only
+checkpoint artifact (``checkpoint.py``) and the end-to-end orchestration
+(``recipe.py``). Sharding resolves through the ``gpt_lora`` family of the
+partition-rule registry (``parallel/rules.py``) — no hand-wiring in the
+engine, the ZeRO helpers, shardcheck or either checkpoint codec.
+"""
+
+from fleetx_tpu.finetune.checkpoint import (AdapterDriftError,
+                                            apply_adapter_checkpoint,
+                                            load_adapter, save_adapter)
+from fleetx_tpu.finetune.lora import (adapter_mask, inject_adapters,
+                                      lora_optimizer, merge_adapters,
+                                      split_adapters,
+                                      trainable_params_frac)
+from fleetx_tpu.finetune.module import LoRAGPTModule
+
+__all__ = [
+    "AdapterDriftError", "LoRAGPTModule", "adapter_mask",
+    "apply_adapter_checkpoint", "inject_adapters", "load_adapter",
+    "lora_optimizer", "merge_adapters", "save_adapter", "split_adapters",
+    "trainable_params_frac",
+]

@@ -1,0 +1,250 @@
+"""Adapter-only checkpoint artifact (docs/finetune.md "Adapter artifact").
+
+A fine-tune run's durable product is TINY: the adapter leaves plus enough
+provenance to prove what they belong to. The artifact is a ``step_<N>``
+directory in the shared checkpoint idiom — ``state.npz`` payload,
+``fleetx_integrity.json`` manifest (PR 7), ``fleetx_meta.json``
+completion marker — so ``tools/verify_ckpt.py`` audits it unmodified and
+the retention/latest-step helpers in ``core/checkpoint.py`` apply as-is.
+
+The meta stamps three identities and the restore REFUSES loudly when any
+has drifted (:class:`AdapterDriftError` naming the offending leaf /
+fingerprint — an adapter is meaningless against the wrong base and must
+never be silently merged):
+
+- ``base_leaves``: per-leaf content digests of the frozen base the
+  adapters were trained against (name → crc32/nbytes);
+- ``spec_registry``: the partition-rule registry fingerprint
+  (``parallel/rules.py``) — unlike full checkpoints, which re-shard onto
+  current rules with a warning, adapters refuse on registry drift, since
+  the rule table also defines the adapter leaf naming contract;
+- ``base_ckpt``: the pretrain checkpoint directory path, recorded for
+  operators (informational — the digests are the authority).
+
+Payload integrity itself follows the PR 7 contract: file digests verified
+before any byte is decoded, npz leaves re-verified against the per-leaf
+digests computed at save.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from fleetx_tpu.core import checkpoint as ckpt_lib
+from fleetx_tpu.finetune import lora
+from fleetx_tpu.parallel import rules as rules_lib
+from fleetx_tpu.resilience import integrity
+from fleetx_tpu.resilience.integrity import CheckpointIntegrityError
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["AdapterDriftError", "ADAPTER_ARTIFACT", "save_adapter",
+           "load_adapter", "apply_adapter_checkpoint", "adapter_bytes"]
+
+#: meta marker distinguishing adapter artifacts from full checkpoints
+ADAPTER_ARTIFACT = "lora_adapter"
+
+_PAYLOAD = "state.npz"
+
+
+class AdapterDriftError(RuntimeError):
+    """An adapter artifact was offered a base (or registry) it was not
+    trained against. Never absorbed by retry policies and never merged
+    anyway — the caller must re-point the base or re-train the adapter."""
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"step_{int(step)}")
+
+
+def save_adapter(directory: str, step: int, params: Any, *,
+                 base_dir: Optional[str], rank: int, alpha: float,
+                 base_digests: Optional[dict] = None,
+                 extra_meta: Optional[dict] = None) -> str:
+    """Publish one adapter-only artifact for ``step`` under ``directory``.
+
+    ``params`` is the full fine-tune tree (base + adapters); only the
+    adapter leaves are persisted, the base contributes its per-leaf
+    digests — pass ``base_digests`` when the caller already holds them
+    (the recipe's frozen-base audit just computed exactly these; a full
+    re-digest is a whole-base host fetch + CRC). Write order follows the
+    core codec's completion contract: payload → manifest → meta marker,
+    each atomic, so a directory with a meta is always a fully-described
+    artifact.
+    """
+    path = _step_dir(directory, step)
+    os.makedirs(path, exist_ok=True)
+    base_tree, adapters = lora.split_adapters(params)
+    assert adapters, "params carry no adapter leaves — nothing to save"
+    names = sorted(adapters)
+    host = [np.ascontiguousarray(np.asarray(jax.device_get(adapters[n])))
+            for n in names]
+    arrays = {f"leaf_{i}": leaf for i, leaf in enumerate(host)}
+    arrays["__names__"] = np.array(names)
+    arrays["__dtypes__"] = np.array([str(l.dtype) for l in host])
+    integrity.atomic_write(os.path.join(path, _PAYLOAD),
+                           lambda f: np.savez(f, **arrays), mode="wb")
+    leaf_digests = [integrity.digest_array(leaf) for leaf in host]
+    integrity.write_manifest(path, leaves=leaf_digests)
+    meta = {
+        "step": int(step),
+        "artifact": ADAPTER_ARTIFACT,
+        "spec_family": "gpt_lora",
+        # per-FAMILY fingerprint: the adapter's contract is its own rule
+        # table, so an unrelated family's edit never bricks the artifact
+        "spec_registry": rules_lib.family_fingerprint("gpt_lora"),
+        "base_ckpt": os.path.abspath(base_dir) if base_dir else None,
+        "lora": {"rank": int(rank), "alpha": float(alpha),
+                 "names": names},
+        "base_leaves": dict(base_digests) if base_digests is not None
+        else lora.base_leaf_digests(base_tree),
+    }
+    meta.update(extra_meta or {})
+    integrity.atomic_write(os.path.join(path, "fleetx_meta.json"),
+                           lambda f: json.dump(meta, f))
+    logger.info("saved adapter artifact: %s (%d leaves, %d bytes)", path,
+                len(names), adapter_bytes(path))
+    return path
+
+
+def adapter_bytes(path: str) -> int:
+    """On-disk payload bytes of one adapter step dir (the <5%-of-base
+    acceptance measurement, tests/test_zz_finetune.py)."""
+    target = os.path.join(path, _PAYLOAD)
+    return os.path.getsize(target) if os.path.exists(target) else 0
+
+
+def _read_meta(path: str) -> dict:
+    """The artifact's meta dict; unreadable/corrupt is a loud failure (an
+    adapter without provenance must not be merged)."""
+    target = os.path.join(path, "fleetx_meta.json")
+    try:
+        with open(target) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"adapter meta {target} unreadable ({e}) — refusing to merge "
+            f"an adapter without provenance") from e
+    if meta.get("artifact") != ADAPTER_ARTIFACT:
+        raise AdapterDriftError(
+            f"{path} is not an adapter artifact (artifact="
+            f"{meta.get('artifact')!r}) — point adapter_dir at a "
+            f"finetune/checkpoint.py save_adapter directory")
+    return meta
+
+
+def _check_registry(meta: dict, path: str) -> None:
+    """Registry drift is a REFUSAL for adapters (full checkpoints only
+    warn): the family's rule table also defines the adapter
+    naming/placement contract the artifact was trained under. Scoped to
+    the artifact's OWN family fingerprint, so edits to other families'
+    tables never refuse a published adapter."""
+    stamped = meta.get("spec_registry")
+    family = meta.get("spec_family") or "gpt_lora"
+    current = rules_lib.family_fingerprint(family)
+    if stamped != current:
+        raise AdapterDriftError(
+            f"adapter {path} was saved under {family!r} rule table "
+            f"{stamped} but the current table fingerprints as {current} "
+            f"— the family's rules have changed since training; refusing "
+            f"to merge (re-train the adapter or restore the rules)")
+
+
+def _check_base(meta: dict, base_params: Any, path: str) -> None:
+    """Refuse on base drift, naming the first mismatching leaf."""
+    want = dict(meta.get("base_leaves") or {})
+    got = lora.base_leaf_digests(base_params)
+    missing = sorted(set(want) - set(got))
+    if missing:
+        raise AdapterDriftError(
+            f"adapter {path} expects base leaf {missing[0]!r} which the "
+            f"offered base tree lacks ({len(missing)} missing leaves) — "
+            f"wrong or restructured base checkpoint")
+    extra = sorted(set(got) - set(want))
+    if extra:
+        raise AdapterDriftError(
+            f"offered base tree carries leaf {extra[0]!r} the adapter "
+            f"{path} was not trained against ({len(extra)} extra leaves)")
+    for name in sorted(want):
+        w, g = want[name], got[name]
+        if int(w["crc32"]) != int(g["crc32"]) or \
+                int(w["nbytes"]) != int(g["nbytes"]):
+            raise AdapterDriftError(
+                f"base leaf {name!r} has drifted from the weights adapter "
+                f"{path} was trained against (crc "
+                f"{int(g['crc32']):#010x} != stamped "
+                f"{int(w['crc32']):#010x}) — refusing to merge onto the "
+                f"wrong base")
+
+
+def load_adapter(directory: str, step: Optional[int] = None, *,
+                 base_params: Any = None) -> tuple[dict, dict]:
+    """Load (and fully verify) one adapter artifact.
+
+    Returns ``(adapters_by_name, meta)``. Verification order: manifest
+    file digests (payload bytes) → registry fingerprint → base per-leaf
+    digests (when ``base_params`` is offered) → npz leaf digests. Any
+    failure is a loud :class:`AdapterDriftError` /
+    :class:`CheckpointIntegrityError` — never a silent merge.
+    """
+    directory = os.path.abspath(directory)
+    step = step if step is not None else ckpt_lib.latest_step(directory)
+    if step is None:
+        raise FileNotFoundError(
+            f"no adapter artifact under {directory}")
+    path = _step_dir(directory, step)
+    meta = _read_meta(path)
+    manifest = integrity.read_manifest(path)
+    if manifest is None:
+        raise CheckpointIntegrityError(
+            f"adapter {path} carries no integrity manifest — adapter "
+            f"artifacts are always manifested; refusing to merge "
+            f"unverifiable bytes")
+    bad = integrity.verify_files(path, manifest)
+    if bad:
+        raise CheckpointIntegrityError(
+            f"adapter {path} failed integrity verification: files {bad} "
+            f"do not match the manifest digests")
+    _check_registry(meta, path)
+    if base_params is not None:
+        _check_base(meta, base_params, path)
+    leaf_digests = manifest.get("leaves") or []
+    bad_leaves = integrity.verify_npz_leaves(path, leaf_digests)
+    if bad_leaves:
+        raise CheckpointIntegrityError(
+            f"adapter {path} leaves {bad_leaves} do not match their "
+            f"manifest digests — refusing to merge corrupt adapters")
+    adapters: dict = {}
+    with np.load(os.path.join(path, _PAYLOAD)) as data:
+        names = [str(n) for n in data["__names__"]]
+        dtypes = [str(d) for d in data["__dtypes__"]]
+        for i, name in enumerate(names):
+            arr = data[f"leaf_{i}"]
+            if str(arr.dtype) != dtypes[i]:
+                # extension dtype flattened by the npy format — re-view
+                arr = arr.view(np.dtype(dtypes[i]))
+            adapters[name] = arr
+    logger.info("loaded adapter artifact %s (step %d, %d leaves%s)", path,
+                int(step), len(adapters),
+                ", base verified" if base_params is not None else "")
+    return adapters, meta
+
+
+def apply_adapter_checkpoint(base_params: Any, directory: str,
+                             step: Optional[int] = None) -> Any:
+    """Base params + adapter artifact → merged serving weights.
+
+    The one-call serving path (``tools/serve.py``): verifies the artifact
+    AND the offered base against the stamped digests, grafts the adapter
+    leaves, and folds ``B@A`` into the kernels — the returned tree has
+    the base model's exact structure, so the quantized decode programs
+    run the fine-tuned weights with zero per-token adapter cost.
+    """
+    adapters, meta = load_adapter(directory, step, base_params=base_params)
+    combined = lora.combine_adapters(base_params, adapters)
+    return lora.merge_adapters(combined,
+                               alpha=float(meta["lora"]["alpha"]))

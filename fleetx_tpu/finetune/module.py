@@ -1,0 +1,86 @@
+"""LoRA fine-tuning task module (docs/finetune.md).
+
+``LoRAGPTModule`` is the ``GPTModule`` recipe with three changes and
+nothing else:
+
+- ``init_variables`` injects the ``lora_a``/``lora_b`` leaves next to the
+  registry-named target kernels (``finetune/lora.py``), so the engine's
+  TrainState carries base + adapters as ONE pytree;
+- ``spec_family`` is ``gpt_lora`` — the engine, shardcheck, the ZeRO
+  helpers and both checkpoint codecs resolve the adapted tree through the
+  partition-rule registry with no hand-wiring;
+- every pure function (training/validation loss, predict) folds the
+  adapters into the base kernels first (``merge_adapters``), so the model
+  code runs unmodified while gradients flow to the adapter leaves through
+  the fold. The base stays bitwise frozen because the optimizer is
+  masked (``lora.lora_optimizer``), not because the math hides it.
+
+Config surface (the ``FineTune:`` YAML section)::
+
+    FineTune:
+      base_ckpt: ./output/pretrain      # pretrain checkpoint dir (step_N)
+      adapter_dir: ./output/adapters    # where adapter artifacts land
+      lora:
+        rank: 8
+        alpha: 16.0
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from fleetx_tpu.core.module import GPTModule
+from fleetx_tpu.finetune import lora
+from fleetx_tpu.utils.log import logger
+
+
+class LoRAGPTModule(GPTModule):
+    """GPT fine-tuning task: frozen base + trainable low-rank adapters."""
+
+    #: shadows GPTModule's property — the adapted tree is its own registry
+    #: family (``parallel/rules.py``), base rules + the adapter rules
+    spec_family = "gpt_lora"
+
+    def __init__(self, cfg: Any):
+        ft = dict(cfg.get("FineTune") or {}) if isinstance(cfg, dict) else {}
+        lora_cfg = dict(ft.get("lora") or {})
+        self.lora_rank = int(lora_cfg.get("rank") or 8)
+        self.lora_alpha = float(lora_cfg.get("alpha")
+                                or 2.0 * self.lora_rank)
+        self.base_ckpt = ft.get("base_ckpt")
+        self.adapter_dir = ft.get("adapter_dir")
+        super().__init__(cfg)
+        assert self.model_cfg.moe_num_experts == 0, \
+            "LoRA targets the dense GPT stack (gpt_lora rules carry no " \
+            "expert templates) — fine-tune the dense model"
+        logger.info("LoRA adapters: rank=%d alpha=%.1f targets=%s",
+                    self.lora_rank, self.lora_alpha,
+                    sorted(lora.LORA_TARGETS))
+
+    def init_variables(self, rng: jax.Array, batch: dict) -> Any:
+        """Base init + adapter injection (A small-normal, B zeros — the
+        starting model IS the base model; the base values are then
+        overwritten by the pretrain restore, ``finetune/recipe.py``)."""
+        params = super().init_variables(rng, batch)
+        return lora.inject_adapters(params, rank=self.lora_rank,
+                                    rng=jax.random.fold_in(rng, 0x10A))
+
+    def _merged(self, params: Any) -> Any:
+        """The effective (base ⊕ adapters) tree the model consumes."""
+        return lora.merge_adapters(params, alpha=self.lora_alpha)
+
+    def training_loss(self, params, batch, rng, step):
+        """Fine-tune loss: the base loss over the merged kernels —
+        gradients reach the adapter leaves through the fold."""
+        return super().training_loss(self._merged(params), batch, rng,
+                                     step)
+
+    def validation_loss(self, params, batch):
+        """Validation loss over the merged kernels."""
+        return super().validation_loss(self._merged(params), batch)
+
+    def predict_step(self, params, batch):
+        """Forward logits over the merged kernels."""
+        return super().predict_step(self._merged(params), batch)

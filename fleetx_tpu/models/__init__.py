@@ -21,6 +21,11 @@ def get_registry():
     except ImportError:
         pass
     try:
+        from fleetx_tpu.finetune.module import LoRAGPTModule
+        modules["LoRAGPTModule"] = LoRAGPTModule
+    except ImportError:
+        pass
+    try:
         from fleetx_tpu.models.vision.module import GeneralClsModule
         modules["GeneralClsModule"] = GeneralClsModule
     except ImportError:

@@ -10,8 +10,9 @@ stage shards which term, and the serving KV pool hand-wired its own
 ``PartitionSpec``. A bad spec surfaced only at jit bind time on real
 hardware. Here the whole mapping is *data*:
 
-- ``PARTITION_RULES``: per model family (``gpt``, ``gpt_moe``, ``vision``,
-  ``ernie``, ``imagen``, plus the serving KV pool as ``serving_kv``), an
+- ``PARTITION_RULES``: per model family (``gpt``, ``gpt_moe``,
+  ``gpt_lora``, ``vision``, ``ernie``, ``imagen``, plus the serving KV
+  pool as ``serving_kv``), an
   ORDERED tuple of ``(regex, logical-axes template)`` rules matched against
   slash-joined parameter-tree paths, first match wins — the
   ``match_partition_rules`` pattern of "Scalable Training of Language
@@ -63,7 +64,7 @@ __all__ = [
     "registry_specs", "named_shardings", "tree_leaf_names", "spec_for",
     "canonicalize", "first_free_divisible_dim", "with_fsdp_axis",
     "stage_shards", "kv_pool_spec", "batch_spec", "audit_leaves",
-    "registry_fingerprint", "families", "family_of",
+    "registry_fingerprint", "family_fingerprint", "families", "family_of",
 ]
 
 #: the mesh axis vocabulary — THE declaration (``parallel/mesh.py`` builds
@@ -231,12 +232,40 @@ _GPT_COMMON_RULES = (
     (r"(ln1|ln2|ln_f)/(scale|bias)$", ("norm",)),
 )
 
+# LoRA adapter leaves (fleetx_tpu/finetune/lora.py): each registry-named
+# target kernel gains `<kernel>_lora_a` / `<kernel>_lora_b` siblings with
+# delta = B@A folded in at merge. A maps the target's input features to
+# the rank and replicates (the rank dim is tiny and indivisible by
+# design); B maps the rank to the target's output features and inherits
+# the base leaf's OUTPUT-side placement — heads/mlp for the
+# column-parallel qkv/wi, embed for the row-parallel out/wo, whose tensor
+# axis lives on the INPUT side and therefore on no adapter leaf. The
+# injection code derives its flax boxing metadata FROM these templates
+# (lora.adapter_axis_names), so the table is the single source of truth
+# the parity gate in tests/test_zz_shardcheck.py pins.
+_GPT_LORA_RULES = (
+    (r"attn/qkv_kernel_lora_a$", (None, None)),
+    (r"attn/qkv_kernel_lora_b$", (None, None, "heads", "kv")),
+    (r"attn/out_kernel_lora_a$", (None, None, None)),
+    (r"attn/out_kernel_lora_b$", (None, "embed")),
+    (r"mlp/wi_kernel_lora_a$", (None, None)),
+    (r"mlp/wi_kernel_lora_b$", (None, "mlp")),
+    (r"mlp/wo_kernel_lora_a$", (None, None)),
+    (r"mlp/wo_kernel_lora_b$", (None, "embed")),
+)
+
 #: family → ordered (regex, template) rules; first match wins
 PARTITION_RULES: dict[str, tuple] = {
     "gpt": _GPT_ATTN_RULES + _GPT_DENSE_MLP_RULES + _GPT_COMMON_RULES,
     # the MoE stack REPLACES the dense MLP — the dense wi/wo rules are
     # deliberately absent so dead-rule accounting stays exact per family
     "gpt_moe": _GPT_ATTN_RULES + _GPT_MOE_MLP_RULES + _GPT_COMMON_RULES,
+    # parameter-efficient fine-tuning (docs/finetune.md): the dense GPT
+    # tree plus the low-rank adapter leaves — one family so the engine,
+    # both checkpoint codecs, ZeRO specs and shardcheck resolve a LoRA
+    # state with no hand-wiring
+    "gpt_lora": _GPT_LORA_RULES + _GPT_ATTN_RULES + _GPT_DENSE_MLP_RULES
+    + _GPT_COMMON_RULES,
     "vision": _GPT_ATTN_RULES + _GPT_DENSE_MLP_RULES + (
         (r"(ln1|ln2|ln_f)/(scale|bias)$", ("norm",)),
         (r"(^|/)cls_token$", (None, None, "embed")),
@@ -283,6 +312,7 @@ PARTITION_RULES: dict[str, tuple] = {
 STACK_MARKERS: dict[str, str] = {
     "gpt": r"(^|/)layers/",
     "gpt_moe": r"(^|/)layers/",
+    "gpt_lora": r"(^|/)layers/",
     "vision": r"(^|/)blocks/",
     "ernie": r"(^|/)layers/",
 }
@@ -534,6 +564,21 @@ def registry_fingerprint() -> str:
                     sorted(PARTITION_RULES.items()),
                     sorted(STACK_MARKERS.items()),
                     sorted(REPLICATED_OK), sorted(ZERO_STAGE_TERMS.items())))
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+
+def family_fingerprint(family: str) -> str:
+    """Content hash of ONE family's rule table + the shared axis
+    vocabulary — the adapter-artifact provenance stamp
+    (``finetune/checkpoint.py``). Narrower than
+    :func:`registry_fingerprint` on purpose: an adapter's naming and
+    placement contract is its own family's table, so an unrelated
+    family's edit must not refuse every published adapter."""
+    if family not in PARTITION_RULES:
+        raise KeyError(f"unknown spec family {family!r}; registered: "
+                       f"{families()}")
+    payload = repr((MESH_AXES, LOGICAL_AXES, STACK_AXES, family,
+                    PARTITION_RULES[family], STACK_MARKERS.get(family)))
     return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
 
 

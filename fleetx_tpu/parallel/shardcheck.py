@@ -99,7 +99,7 @@ def _sample_batch(module: Any, family: str) -> dict:
     SHAPES matter (everything runs under ``jax.eval_shape``)."""
     import numpy as np
 
-    if family in ("gpt", "gpt_moe"):
+    if family in ("gpt", "gpt_moe", "gpt_lora"):
         s = int(module.model_cfg.max_position_embeddings)
         tok = np.zeros((1, s), np.int32)
         return {"tokens": tok, "position_ids": tok.copy()}

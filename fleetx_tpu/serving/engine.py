@@ -64,8 +64,15 @@ class ServingConfig:
     prefill_chunk: int = 32     # prompt tokens forwarded per step
     quantize_decode: bool = False  # int8-act decode (Quantization bits)
     # checkpoint directory to restore params from (tools/serve.py feeds it
-    # through the PR 7 integrity-verified loader); None = seeded init
+    # through the PR 7 integrity-verified loader, restoring each leaf
+    # DIRECTLY onto its registry sharding when the replica runs a mesh);
+    # None = seeded init
     ckpt_dir: Optional[str] = None
+    # LoRA adapter artifact directory (finetune/checkpoint.py): verified
+    # against the base weights + registry fingerprint, then merged — the
+    # decode programs run the fine-tuned weights at zero adapter cost
+    # (docs/finetune.md); requires ckpt_dir
+    adapter_dir: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "ServingConfig":

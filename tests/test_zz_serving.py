@@ -308,6 +308,60 @@ def test_pool_sharded_over_mesh_keeps_parity(small_model, devices8):
         (None, "fsdp", None, "tensor", None)
 
 
+def test_registry_sharded_weights_compose_with_sharded_pool(devices8,
+                                                            tmp_path):
+    """ROADMAP items 1+4, last rung: replica WEIGHTS restore through the
+    partition-rule registry onto the serving mesh
+    (``load_params(mesh=...)``, the tools/serve.py ckpt_dir path) instead
+    of a replicated host load — and compose with the fsdp/tensor-sharded
+    page pool at token parity with the one-shot reference."""
+    from flax.core import meta as flax_meta
+
+    from fleetx_tpu.core import checkpoint as ckpt_lib
+    from fleetx_tpu.parallel import rules as R
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    # tensor-divisible variant of the tiny model (vocab 97 cannot split
+    # over mp=2; the parity reference EOS stays 96)
+    cfg = config_from_dict(dict(MODEL_DICT, vocab_size=128))
+    model = GPTForPretraining(cfg)
+    params = flax_meta.unbox(model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        None, deterministic=True)["params"])
+    ckpt_lib.save_checkpoint(
+        str(tmp_path), 0, {"params": params},
+        meta={"spec_family": "gpt",
+              "spec_registry": R.registry_fingerprint()})
+    mesh = build_mesh({"fsdp_degree": 2, "mp_degree": 2})
+    loaded = ckpt_lib.load_params(str(tmp_path), mesh=mesh)
+    flat = dict(R.tree_leaf_names(loaded))
+    # registry placement, not a replicated host load
+    assert tuple(
+        flat["gpt/embeddings/word_embeddings"].sharding.spec) == \
+        ("tensor",)
+    assert "tensor" in str(
+        flat["gpt/layers/attn/qkv_kernel"].sharding.spec)
+    eng = ServingEngine(
+        cfg, loaded,
+        ServingConfig(max_batch=2, page_size=4, num_pages=32,
+                      max_seq_len=32, prefill_chunk=4),
+        eos_token_id=EOS, mesh=mesh)
+    prompts = [[5, 9, 23, 41], [7, 3]]
+    want = one_shot(model, params, prompts, 6)
+    reqs = [eng.submit(p, 6, request_id=f"w{i}")
+            for i, p in enumerate(prompts)]
+    eng.run_until_drained()
+    for req, row in zip(reqs, want):
+        check_parity(req, row)
+
+    def norm(spec):
+        return (tuple(spec) + (None,) * 5)[:5]
+
+    # pool AND weights sharded simultaneously, through the whole run
+    assert norm(eng.pool_k.sharding.spec) == \
+        (None, "fsdp", None, "tensor", None)
+
+
 # ---------------------------------------------------------------------------
 # telemetry schema + perf gate wiring
 # ---------------------------------------------------------------------------

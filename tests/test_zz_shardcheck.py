@@ -230,6 +230,7 @@ def test_registry_fingerprint_tracks_mutation(monkeypatch):
 
 def _family_modules():
     from fleetx_tpu.core.module import GPTModule
+    from fleetx_tpu.finetune.module import LoRAGPTModule
     from fleetx_tpu.models.ernie.module import ErnieModule
     from fleetx_tpu.models.imagen.module import ImagenModule
     from fleetx_tpu.models.vision.module import GeneralClsModule
@@ -253,6 +254,16 @@ def _family_modules():
            {"pp_degree": 2})
     yield ("gpt_moe", GPTModule({"Model": dict(TINY, moe_num_experts=4,
                                                moe_top_k=2)}), TOK, {})
+    # LoRA fine-tuning (docs/finetune.md): the adapted tree is its own
+    # family — base rules + adapter rules — and the injected leaves carry
+    # registry-derived flax boxing, so the parity gate pins both sides
+    yield ("gpt_lora", LoRAGPTModule({"Model": dict(TINY),
+                                      "FineTune": {"lora": {"rank": 4}}}),
+           TOK, {})
+    yield ("gpt_lora stage3",
+           LoRAGPTModule({"Model": dict(TINY),
+                          "FineTune": {"lora": {"rank": 4}}}), TOK,
+           {"sharding": {"sharding_stage": 3}})
     yield ("vision", GeneralClsModule(vit),
            {"images": np.zeros((1, 32, 32, 3), np.float32)}, {})
     yield ("ernie", ErnieModule({"Model": dict(TINY, type_vocab_size=2)}),

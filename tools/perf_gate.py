@@ -71,6 +71,18 @@ DECOMP_METRICS = {
     "decomposition.fwd_scan_ms_per_layer": ("lower", 0.10, 0.05),
     "decomposition.gap_ms": ("lower", 0.15, 1.0),
 }
+#: fine-tune micro-bench rows (bench.py "finetune" phase, docs/finetune.md):
+#: the adapter step regresses UP with the usual noise-aware band;
+#: trainable_params_frac and the adapter payload bytes are STRUCTURAL —
+#: the frac exact-matches (it is a deterministic ratio of the config, any
+#: change means the mask or the targets moved) and the bytes carry a 4 KiB
+#: absolute floor over npz/zip jitter. All skip when absent (baselines
+#: predating the finetune subsystem).
+FINETUNE_METRICS = {
+    "finetune.adapter_step_time_s": ("lower", 0.25, 0.01),
+    "finetune.trainable_params_frac": ("exact", 0.0, 0.0),
+    "finetune.adapter_ckpt_bytes": ("lower", 0.0, 4096.0),
+}
 #: serving-bench SLOs (tools/serve.py --bench, docs/serving.md): decode
 #: throughput regresses DOWN, tail latencies UP. Bands are wider than the
 #: training ones (a Poisson stream adds arrival jitter on top of host
@@ -112,6 +124,7 @@ def compare(fresh: dict, base: dict,
     """
     specs = dict(GATE_METRICS)
     specs.update(DECOMP_METRICS)
+    specs.update(FINETUNE_METRICS)
     specs.update(SERVING_METRICS)
     for key in sorted(set(list((base.get("span_means_ms") or {}))
                           + list((fresh.get("span_means_ms") or {})))):
@@ -239,6 +252,25 @@ def self_check(baseline_entry: dict) -> list[str]:
     drifted["perf_bwd_ms_per_layer"] = 6.0
     rows = compare(drifted, seeded)
     for metric in ("flash_bwd_passes", "perf_bwd_ms_per_layer"):
+        if not any(r["metric"] == metric and r["verdict"] == "FAIL"
+                   for r in rows):
+            problems.append(f"synthetic {metric} regression NOT caught")
+    # finetune rows self-check the same way (their real rows skip-if-absent
+    # on pre-finetune baselines): identical copies pass, a 2x adapter-step
+    # slowdown and ANY trainable-frac change must fail
+    ft = dict(baseline_entry)
+    ft["finetune"] = {"adapter_step_time_s": 0.1,
+                      "trainable_params_frac": 0.07,
+                      "adapter_ckpt_bytes": 36000.0}
+    rows = compare(json.loads(json.dumps(ft)), ft)
+    if any(r["verdict"] == "FAIL" for r in rows):
+        problems.append("identical finetune rows flagged as regression")
+    drifted_ft = json.loads(json.dumps(ft))
+    drifted_ft["finetune"]["adapter_step_time_s"] = 0.2
+    drifted_ft["finetune"]["trainable_params_frac"] = 0.08
+    rows = compare(drifted_ft, ft)
+    for metric in ("finetune.adapter_step_time_s",
+                   "finetune.trainable_params_frac"):
         if not any(r["metric"] == metric and r["verdict"] == "FAIL"
                    for r in rows):
             problems.append(f"synthetic {metric} regression NOT caught")

@@ -66,17 +66,45 @@ def _build_engine(cfg: dict):
     eos = int(gen.get("eos_token_id", 50256))
 
     model = GPTForPretraining(model_cfg)
+    mesh = serving_mesh(cfg.get("Distributed"))
     ckpt_dir = serving.ckpt_dir
     if ckpt_dir:
         from fleetx_tpu.core.checkpoint import load_params
 
-        params = load_params(str(ckpt_dir))
+        # registry-sharded replica weights (parallel/rules.py): every
+        # leaf restores DIRECTLY onto its partition-rule sharding (family
+        # from the checkpoint meta) instead of a replicated host load —
+        # the weight-side counterpart of the sharded KV pool, so a large
+        # checkpoint loads on a mesh whose per-device HBM cannot hold
+        # the full tree. An unsharded replica loads through a trivial
+        # 1-device mesh: the registry specs collapse to replicated AND
+        # the restore stays topology-free (a mesh-trained checkpoint's
+        # stored sharding references devices this process lacks — without
+        # a concrete target sharding Orbax refuses the cross-topology
+        # restore)
+        from fleetx_tpu.parallel.mesh import build_mesh
+        from fleetx_tpu.parallel.rules import SpecLayout
+
+        load_mesh = mesh if mesh is not None else \
+            build_mesh({}, devices=jax.devices()[:1])
+        params = load_params(
+            str(ckpt_dir), mesh=load_mesh,
+            layout=SpecLayout.from_dist_config(
+                dict(cfg.get("Distributed") or {})))
     else:
         seed = int((cfg.get("Global") or {}).get("seed", 0))
         params = model.init(
             {"params": jax.random.PRNGKey(seed)},
             jnp.zeros((1, 8), jnp.int32), None, deterministic=True)["params"]
-    mesh = serving_mesh(cfg.get("Distributed"))
+    if serving.adapter_dir:
+        # fine-tuned serving (docs/finetune.md): merge the LoRA adapter
+        # artifact into the base weights — verified against the stamped
+        # base digests + registry fingerprint, refused loudly on drift
+        assert ckpt_dir, "Serving.adapter_dir requires Serving.ckpt_dir " \
+                         "(the adapter's frozen base)"
+        from fleetx_tpu.finetune.checkpoint import apply_adapter_checkpoint
+
+        params = apply_adapter_checkpoint(params, str(serving.adapter_dir))
     return ServingEngine(model_cfg, params, serving, sampling,
                          eos_token_id=eos, mesh=mesh,
                          seed=int((cfg.get("Global") or {}).get("seed", 0)))
