@@ -23,8 +23,6 @@ import os
 import time
 from typing import Any, Optional
 
-import jax
-
 from fleetx_tpu.observability import flight as flight_mod
 from fleetx_tpu.observability import gang as gang_mod
 from fleetx_tpu.observability.flight import FlightRecorder  # noqa: F401
@@ -50,8 +48,10 @@ __all__ = [
 
 def _process_count() -> int:
     try:
+        import jax  # deferred: package import stays jax-free (router reuse)
+
         return jax.process_count()
-    except RuntimeError:  # backend not initialised yet
+    except (ImportError, RuntimeError):  # backend not initialised yet
         return 1
 
 
@@ -164,6 +164,8 @@ class Observability:
     def init_derived(self, flops_per_token: Optional[float],
                      n_devices: int) -> None:
         """Create the DerivedMetrics layer once the module/mesh are known."""
+        import jax
+
         from fleetx_tpu.utils.hardware import peak_flops
 
         self.derived = DerivedMetrics(

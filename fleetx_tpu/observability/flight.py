@@ -36,14 +36,57 @@ from typing import Any, Optional
 
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["FlightRecorder", "install", "get_recorder", "note", "dump",
-           "ENV_DIR", "DEFAULT_CAPACITY"]
+__all__ = ["EventRing", "FlightRecorder", "install", "get_recorder",
+           "note", "dump", "ENV_DIR", "DEFAULT_CAPACITY"]
 
 #: per-rank dump directory override — ``tools/supervise.py`` sets this to a
 #: per-generation, per-rank path so restart evidence never collides
 ENV_DIR = "FLEETX_FLIGHT_DIR"
 
 DEFAULT_CAPACITY = 512
+
+
+class EventRing:
+    """Bounded, lock-guarded event ring: the newest ``capacity`` events win.
+
+    The shared substrate under the crash recorder below and the serving
+    engine's per-request lifecycle timelines (``serving/engine.py``) —
+    both need "append cheaply forever, keep only the tail, count what
+    fell off". Appends and snapshots are safe across threads (connection
+    handlers read timelines the engine thread is still writing).
+    """
+
+    __slots__ = ("capacity", "_ring", "_lock", "_total")
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(int(capacity), 1)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def append(self, evt: dict) -> None:
+        """Append one event; the oldest falls off silently (``dropped``
+        keeps the eviction countable)."""
+        with self._lock:
+            self._ring.append(evt)
+            self._total += 1
+
+    def snapshot(self) -> list:
+        """Copy of the current ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def total(self) -> int:
+        """All-time appended count (ring eviction is invisible here)."""
+        with self._lock:
+            return self._total
+
+    @property
+    def dropped(self) -> int:
+        """How many events have been evicted off the ring."""
+        with self._lock:
+            return self._total - len(self._ring)
 
 
 class FlightRecorder:
@@ -61,9 +104,7 @@ class FlightRecorder:
         self.rank = int(rank)
         self.world = int(world)
         self.capacity = max(int(capacity), 1)
-        self._ring: deque = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
-        self._recorded = 0  # all-time count (ring eviction is invisible)
+        self._ring = EventRing(self.capacity)
         self.dump_count = 0
         self.last_reason: Optional[str] = None
 
@@ -79,15 +120,12 @@ class FlightRecorder:
         ``name`` keyword must never clobber the timestamp the post-mortem
         timeline sorts by.
         """
-        evt = {**data, "t": time.time(), "kind": kind, "name": name}
-        with self._lock:
-            self._ring.append(evt)
-            self._recorded += 1
+        self._ring.append({**data, "t": time.time(), "kind": kind,
+                           "name": name})
 
     def events(self) -> list:
         """Snapshot of the current ring, oldest first."""
-        with self._lock:
-            return list(self._ring)
+        return self._ring.snapshot()
 
     def dump(self, reason: str) -> str:
         """Atomically write the ring as ``flight_rank<i>.json``.
@@ -99,14 +137,13 @@ class FlightRecorder:
         """
         from fleetx_tpu.resilience.integrity import atomic_write
 
-        with self._lock:
-            payload = {
-                "rank": self.rank, "world": self.world,
-                "reason": str(reason), "dumped_at": time.time(),
-                "recorded_total": self._recorded,
-                "capacity": self.capacity,
-                "events": list(self._ring),
-            }
+        payload = {
+            "rank": self.rank, "world": self.world,
+            "reason": str(reason), "dumped_at": time.time(),
+            "recorded_total": self._ring.total,
+            "capacity": self.capacity,
+            "events": self._ring.snapshot(),
+        }
         os.makedirs(self.out_dir, exist_ok=True)
         atomic_write(self.path, lambda f: json.dump(payload, f))
         self.dump_count += 1

@@ -64,11 +64,15 @@ STEP_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
 }
 
 
+_NULLABLE_INT = (int, type(None))
+
 # serving-runtime records (docs/serving.md "SLO metrics"): one snapshot
 # per replica flush — ``ServingEngine.serving_snapshot()`` emits exactly
-# this shape and ``tools/serve.py --metrics-out`` appends it as JSONL.
-# TTFT / inter-token quantiles are null until the first request completes
-# (same null-not-zero stance as ``mfu``).
+# this shape, ``tools/serve.py --metrics-out`` appends it as JSONL, and
+# the router's ``stats`` verb returns it verbatim. TTFT / inter-token
+# quantiles are null until the first request completes, and the scheduler
+# gauges are null (with ``scheduler_gauges: "unavailable"``) until the
+# first step runs — same null-not-zero stance as ``mfu``/``hbm_stats``.
 SERVING_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "ts": (_NUM, True),
     "scope": ((str,), True),
@@ -76,16 +80,70 @@ SERVING_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "requests_admitted": ((int,), True),
     "requests_completed": ((int,), True),
     "requests_refused": ((int,), True),
-    "queue_depth": ((int,), True),
-    "active_requests": ((int,), True),
-    "page_occupancy": (_NUM, True),
-    "kv_fragmentation": (_NUM, False),
+    "queue_depth": (_NULLABLE_INT, True),
+    "active_requests": (_NULLABLE_INT, True),
+    "page_occupancy": (_NULLABLE_NUM, True),
+    "kv_fragmentation": (_NULLABLE_NUM, False),
+    # explicit availability marker for the four scheduler gauges above:
+    # "ok" once the engine has stepped, "unavailable" before (a genuine
+    # 0.0 occupancy and "never measured" must not collapse to one value)
+    "scheduler_gauges": ((str,), False),
     "tokens_total": ((int,), True),
     "tokens_per_sec": (_NULLABLE_NUM, True),
     "ttft_p50_s": (_NULLABLE_NUM, True),
     "ttft_p99_s": (_NULLABLE_NUM, True),
     "itl_p50_s": (_NULLABLE_NUM, True),
     "itl_p99_s": (_NULLABLE_NUM, True),
+    # full windowed histogram summaries (count/mean/min/max/p50/p95/p99)
+    # — the router pools these count-weighted into the fleet record
+    "ttft": ((dict,), False),
+    "itl": ((dict,), False),
+    # fleet-economics context (PR 16): chips this replica occupies and
+    # completions per chip; slo_attainment is null until a window fills
+    "chips": ((int,), False),
+    "requests_per_chip": (_NULLABLE_NUM, False),
+    "slo_attainment": (_NULLABLE_NUM, False),
+    "replica": ((str,), False),
+}
+
+# fleet records (docs/serving.md "Observability"): the router's periodic
+# merge of every reporting replica's serving snapshot — counters summed,
+# TTFT/ITL pooled count-weighted with the worst replica attributed,
+# requests-per-chip over the fleet's total chips. ``replicas_reported``
+# records actual coverage (a draining/crashed replica just doesn't
+# report), mirroring ``ranks_reported`` in the gang records.
+FLEET_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
+    "ts": (_NUM, True),
+    "scope": ((str,), True),            # always "fleet"
+    "schema_version": ((int,), False),
+    "replicas_total": ((int,), True),
+    "replicas_reported": ((int,), True),
+    "requests_admitted": ((int,), True),
+    "requests_completed": ((int,), True),
+    "requests_refused": ((int,), True),
+    "tokens_total": ((int,), True),
+    "tokens_per_sec": (_NULLABLE_NUM, True),
+    "chips_total": ((int,), True),
+    "requests_per_chip": (_NULLABLE_NUM, True),
+    "queue_depth": (_NULLABLE_INT, False),
+    "active_requests": (_NULLABLE_INT, False),
+    "page_occupancy_mean": (_NULLABLE_NUM, False),
+    "page_occupancy_max": (_NULLABLE_NUM, False),
+    "page_occupancy_max_replica": ((str,), False),
+    "ttft_mean_s": (_NULLABLE_NUM, False),
+    "ttft_p99_s": (_NULLABLE_NUM, False),
+    "ttft_p99_replica": ((str,), False),
+    "itl_mean_s": (_NULLABLE_NUM, False),
+    "itl_p99_s": (_NULLABLE_NUM, False),
+    "itl_p99_replica": ((str,), False),
+    "slo_attainment": (_NULLABLE_NUM, False),
+    # router-side dispatch counters (serving/router.py)
+    "dispatched_total": ((int,), False),
+    "redispatched_total": ((int,), False),
+    "penalties_total": ((int,), False),
+    "drain_refusals_total": ((int,), False),
+    "no_backend_total": ((int,), False),
+    "completed_total": ((int,), False),
 }
 
 #: registry metric names the serving runtime owns (docs/observability.md):
@@ -98,6 +156,13 @@ SERVING_METRIC_NAMES = (
     "serving_requests_refused", "serving_tokens_total",
 )
 
+#: registry names the SLO layer owns (observability/slo.py) — per-target
+#: gauges/counters append ``.<class>.<target>`` suffixes to these stems
+SLO_METRIC_NAMES = (
+    "slo_attainment", "slo_burn_rate", "slo_breaches_total",
+    "slo_evaluations_total",
+)
+
 
 def record_schema_version(record: dict) -> int:
     """A record's schema version (absent → 1, the pre-gang layout)."""
@@ -108,6 +173,11 @@ def record_schema_version(record: dict) -> int:
 def validate_serving_record(record: Any) -> list[str]:
     """Errors for one serving snapshot record; empty list means valid."""
     return _validate_against(record, SERVING_RECORD_SCHEMA)
+
+
+def validate_fleet_record(record: Any) -> list[str]:
+    """Errors for one router-merged fleet record; empty list means valid."""
+    return _validate_against(record, FLEET_RECORD_SCHEMA)
 
 
 def validate_record(record: Any) -> list[str]:
@@ -137,12 +207,15 @@ def _validate_against(record: Any, schema: dict) -> list[str]:
     return errors
 
 
-def validate_lines(lines: Iterable[str],
-                   max_errors: int = 20) -> tuple[int, list[str]]:
+def validate_lines(lines: Iterable[str], max_errors: int = 20,
+                   validator=validate_record) -> tuple[int, list[str]]:
     """Validate JSONL text lines → (record_count, errors).
 
     Errors carry 1-based line numbers; collection stops at ``max_errors``
     so a totally corrupt file doesn't produce megabytes of complaints.
+    ``validator`` picks the schema (step records by default; pass
+    ``validate_serving_record`` / ``validate_fleet_record`` for the
+    serving streams).
     """
     count = 0
     errors: list[str] = []
@@ -156,21 +229,22 @@ def validate_lines(lines: Iterable[str],
             errors.append(f"line {lineno}: invalid JSON ({e})")
         else:
             errors.extend(f"line {lineno}: {msg}"
-                          for msg in validate_record(record))
+                          for msg in validator(record))
         if len(errors) >= max_errors:
             errors.append("... (further errors suppressed)")
             break
     return count, errors
 
 
-def validate_jsonl(path: str, max_errors: int = 20) -> tuple[int, list[str]]:
+def validate_jsonl(path: str, max_errors: int = 20,
+                   validator=validate_record) -> tuple[int, list[str]]:
     with open(path) as f:
-        return validate_lines(f, max_errors=max_errors)
+        return validate_lines(f, max_errors=max_errors, validator=validator)
 
 
-def load_valid_records(path: str) -> list[dict]:
+def load_valid_records(path: str, validator=validate_record) -> list[dict]:
     """Parse + validate; raises ``ValueError`` listing every violation."""
-    count, errors = validate_jsonl(path)
+    count, errors = validate_jsonl(path, validator=validator)
     if errors:
         raise ValueError(f"{path}: {len(errors)} schema violation(s):\n  "
                          + "\n  ".join(errors))

@@ -12,7 +12,9 @@ parseable up to the last flushed record.
   atomically rewritten per flush so a scraper never reads a torn file.
 
 ``build_sinks`` is rank-0 gated via ``jax.process_index()``: on a multi-host
-fleet only one process writes, everyone else gets a no-op list.
+fleet only one process writes, everyone else gets a no-op list. jax is
+imported lazily inside that gate — the module itself stays stdlib-only so
+the jax-free serving router can reuse ``JsonlSink`` for its fleet stream.
 """
 
 from __future__ import annotations
@@ -22,8 +24,6 @@ import json
 import os
 import tempfile
 from typing import Optional
-
-import jax
 
 from fleetx_tpu.utils.log import logger
 
@@ -168,9 +168,10 @@ def build_sinks(sink_names, output_dir: str,
     """
     if rank0_only:
         try:
+            import jax  # deferred: the jax-free router path never gets here
             if jax.process_index() != 0:
                 return []
-        except RuntimeError:  # backend not initialised — single-process
+        except (ImportError, RuntimeError):  # no jax / backend uninitialised
             pass
     sinks: list[Sink] = []
     for name in sink_names or []:

@@ -30,16 +30,20 @@ import threading
 import time
 from typing import Any, Optional
 
-import jax
-
 from fleetx_tpu.observability import flight
 from fleetx_tpu.utils.log import logger
+
+# jax is imported inside the functions that touch the profiler/backend so
+# importing this module (and the observability package) stays jax-free —
+# the stdlib-only serving router reuses the package's sinks/schema
 
 
 def _process_index() -> int:
     try:
+        import jax
+
         return jax.process_index()
-    except RuntimeError:  # backend not initialised yet
+    except (ImportError, RuntimeError):  # backend not initialised yet
         return 0
 
 
@@ -132,6 +136,8 @@ class span:
         self.args = args or None
 
     def __enter__(self):
+        import jax
+
         self._annotation = jax.profiler.TraceAnnotation(self.name)
         self._annotation.__enter__()
         # wall-clock anchor captured at ENTRY (multi-process traces share
@@ -212,6 +218,8 @@ class ProfilerWindow:
         if (not self.enabled or self._active or self._done
                 or step < self.start_step):
             return False
+        import jax
+
         jax.profiler.start_trace(self.output_dir,
                                  create_perfetto_trace=self.detailed)
         self._active = True
@@ -230,6 +238,8 @@ class ProfilerWindow:
         tail isn't truncated (the old inline stop skipped the sync)."""
         if not self._active:
             return
+        import jax
+
         if sync is not None:
             jax.block_until_ready(sync)
         jax.profiler.stop_trace()

@@ -21,8 +21,24 @@ heavy traffic from millions of users" north star needs (docs/serving.md):
   tail-latency JSON joins ``tools/perf_gate.py``.
 """
 
-from fleetx_tpu.serving.engine import ServingConfig, ServingEngine
-from fleetx_tpu.serving.paged_cache import NULL_PAGE, PageAllocator, init_pool
-
 __all__ = ["ServingConfig", "ServingEngine", "PageAllocator", "init_pool",
            "NULL_PAGE"]
+
+#: package export → defining submodule; resolved on first attribute access
+#: (PEP 562) so importing ``fleetx_tpu.serving.router`` — the stdlib-only
+#: fleet front that must start in <1s — never pays the engine's jax import
+_EXPORTS = {
+    "ServingConfig": "engine", "ServingEngine": "engine",
+    "PageAllocator": "paged_cache", "init_pool": "paged_cache",
+    "NULL_PAGE": "paged_cache",
+}
+
+
+def __getattr__(name: str):
+    """Lazy package exports (keeps the router import path jax-free)."""
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"{__name__}.{module}"), name)

@@ -104,8 +104,17 @@ def run_serving_bench(engine: ServingEngine, *, n_requests: int = 32,
             "itl_p50_s": snap["itl_p50_s"],
             "itl_p99_s": snap["itl_p99_s"],
             "page_occupancy_peak": round(occupancy_peak, 4),
+            # gate-facing fleet-economics keys (tools/perf_gate.py
+            # SERVING_METRICS): occupancy under the "higher is better"
+            # band reuses the peak; completions per chip normalises
+            # throughput across replica shapes
+            "page_occupancy": round(occupancy_peak, 4),
+            "requests_per_chip": round(
+                len(completed) / max(engine.n_chips, 1), 3),
         },
     }
+    if snap.get("slo_attainment") is not None:
+        result["serving"]["slo_attainment"] = snap["slo_attainment"]
     logger.info("serving bench: %.1f tokens/s over %d requests "
                 "(ttft p99 %.4fs, itl p99 %.4fs, %d refused)",
                 result["value"], n_requests,

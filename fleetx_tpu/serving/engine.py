@@ -28,8 +28,9 @@ page-occupancy gauges), serving events land in the PR 8 flight ring, and
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Optional
 
 import jax
@@ -42,7 +43,9 @@ import numpy as np
 # (parallel/mesh.py documents the layout-variance this prevents)
 import fleetx_tpu.parallel.mesh  # noqa: F401  (imported for its config pin)
 from fleetx_tpu.observability import flight
+from fleetx_tpu.observability.flight import EventRing
 from fleetx_tpu.observability.metrics import get_registry
+from fleetx_tpu.observability.slo import SLORegistry
 from fleetx_tpu.serving.decode import SamplingParams, make_step_fns
 from fleetx_tpu.serving.paged_cache import (NULL_PAGE, PageAllocator,
                                             init_pool, pool_shardings)
@@ -73,6 +76,14 @@ class ServingConfig:
     # decode programs run the fine-tuned weights at zero adapter cost
     # (docs/finetune.md); requires ckpt_dir
     adapter_dir: Optional[str] = None
+    # per-request lifecycle tracing (docs/serving.md "Observability"):
+    # how many finished/refused timelines stay retrievable behind the
+    # ``trace`` verb, and the per-timeline event-ring capacity
+    trace_requests: int = 256
+    trace_events: int = 128
+    # declarative SLO targets (observability/slo.py) — the ``Serving.slo``
+    # YAML block; None disables SLO evaluation entirely
+    slo: Optional[dict] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "ServingConfig":
@@ -109,6 +120,138 @@ class ServingRequest:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+
+#: lifecycle event taxonomy (docs/serving.md "Observability") — the order
+#: a healthy request walks them; ``refused`` replaces the admitted→finished
+#: span for drain/OOM refusals, ``drain`` marks a preemption landing while
+#: the request was live
+TIMELINE_EVENTS = ("queued", "admitted", "prefill_chunk", "first_token",
+                   "decode_tick", "finished", "refused", "drain")
+
+#: milestone events whose first timestamp is pinned outside the ring so
+#: attribution survives decode-tick eviction on long generations
+_MILESTONES = ("queued", "admitted", "first_token", "finished", "refused")
+
+
+class RequestTimeline:
+    """One request's bounded lifecycle event ring + derived attribution.
+
+    Events ride an ``observability/flight.py``-style ``EventRing``: a
+    long decode drops its oldest ticks (counted, never silent) while the
+    milestone timestamps are pinned on the object, so the queue/prefill/
+    decode decomposition stays exact however many events fell off.
+    """
+
+    def __init__(self, rid: str, capacity: int = 128):
+        self.id = str(rid)
+        self.ring = EventRing(capacity)
+        self.state = "open"  # open | finished | refused
+        self._marks: dict = {}
+        self._pages = 0
+        self._chunks = 0
+        self._ticks = 0
+
+    def note(self, name: str, **data: Any) -> None:
+        """Append one wall-clock-stamped lifecycle event."""
+        evt = {**data, "t": time.time(), "name": name}
+        if name in _MILESTONES and name not in self._marks:
+            self._marks[name] = evt["t"]
+            if name == "admitted":
+                self._pages = int(data.get("pages") or 0)
+        if name == "prefill_chunk":
+            self._chunks += 1
+        elif name == "decode_tick":
+            self._ticks += 1
+        self.ring.append(evt)
+
+    def events(self) -> list:
+        """Snapshot of the event ring, oldest first."""
+        return self.ring.snapshot()
+
+    def attribution(self) -> dict:
+        """Per-phase latency decomposition from the milestone timestamps.
+
+        ``queue_s`` (queued→admitted) + ``prefill_s`` (admitted→first
+        token) = ``ttft_s``, then ``decode_s`` (first token→finished) —
+        the request-path analogue of ``perf.py``'s step-time
+        decomposition: TTFT regressions name their phase. Spans whose
+        endpoints haven't happened are None, never a fake zero.
+        """
+        t = self._marks
+
+        def span(a: str, b: str) -> Optional[float]:
+            return (t[b] - t[a]) if a in t and b in t else None
+
+        total = span("queued", "finished")
+        if total is None:
+            total = span("queued", "refused")
+        return {
+            "queue_s": span("queued", "admitted"),
+            "prefill_s": span("admitted", "first_token"),
+            "decode_s": span("first_token", "finished"),
+            "ttft_s": span("queued", "first_token"),
+            "total_s": total,
+            "pages": self._pages,
+            "prefill_chunks": self._chunks,
+            "decode_ticks": self._ticks,
+        }
+
+    def to_dict(self) -> dict:
+        """The ``trace`` verb's JSON payload for this request."""
+        return {
+            "id": self.id, "state": self.state, "events": self.events(),
+            "events_total": self.ring.total,
+            "events_dropped": self.ring.dropped,
+            "attribution": self.attribution(),
+        }
+
+
+class TimelineStore:
+    """Bounded id → timeline map behind the ``trace`` verb.
+
+    The engine thread writes; connection-handler threads read
+    concurrently, so every map mutation holds the lock (the per-timeline
+    rings carry their own). Finished timelines stay retrievable until
+    ``max_requests`` newer requests evict them, insertion-ordered — the
+    flight-ring stance applied per request.
+    """
+
+    def __init__(self, max_requests: int = 256,
+                 events_per_request: int = 128):
+        self.max_requests = max(int(max_requests), 1)
+        self.events_per_request = max(int(events_per_request), 8)
+        self._lock = threading.Lock()
+        self._timelines: "OrderedDict[str, RequestTimeline]" = OrderedDict()
+
+    def open(self, rid: str) -> RequestTimeline:
+        """Get-or-create the timeline for one request id."""
+        with self._lock:
+            tl = self._timelines.get(str(rid))
+            if tl is None:
+                tl = RequestTimeline(rid, self.events_per_request)
+                self._timelines[str(rid)] = tl
+                while len(self._timelines) > self.max_requests:
+                    self._timelines.popitem(last=False)
+            return tl
+
+    def get(self, rid: str) -> Optional[RequestTimeline]:
+        """The timeline for ``rid`` (None when unknown or evicted)."""
+        with self._lock:
+            return self._timelines.get(str(rid))
+
+    def note(self, rid: str, name: str, **data: Any) -> None:
+        """Append one event onto an existing timeline (no-op on unknown
+        ids — a timeline evicted mid-flight must not resurrect empty)."""
+        tl = self.get(rid)
+        if tl is not None:
+            tl.note(name, **data)
+
+    def live(self) -> list:
+        """Every still-open timeline (the drain/crash dump set)."""
+        with self._lock:
+            return [tl for tl in self._timelines.values()
+                    if tl.state == "open"]
 
 
 class ServingEngine:
@@ -160,6 +303,18 @@ class ServingEngine:
         self.steps = 0
         self._started_at = time.monotonic()
         self.metrics = get_registry()
+        # monotonic id mint: never reset (reset_stats() zeroing the
+        # request counter used to recycle ids across bench windows,
+        # silently merging two requests' timelines and router bookkeeping)
+        self._rid_counter = 0
+        # engine-local gauge freshness: the registry is process-global, so
+        # a prior engine's gauge values must not read as THIS engine's
+        self._gauges_current = False
+        self.timelines = TimelineStore(sc.trace_requests, sc.trace_events)
+        self.slo = SLORegistry.from_config(sc.slo, registry=self.metrics)
+        # chips this replica occupies: its mesh size, or one device for an
+        # unsharded replica — the denominator of requests-per-chip
+        self.n_chips = int(mesh.size) if mesh is not None else 1
         logger.info(
             "serving engine: max_batch=%d pages=%d x %d tokens "
             "(capacity %d token slots/layer), prefill_chunk=%d, "
@@ -173,11 +328,16 @@ class ServingEngine:
                callback: Optional[Callable] = None) -> ServingRequest:
         """Queue one request; refusals (drain / permanent OOM) come back
         with ``state == REFUSED`` and ``error`` set, never queued."""
-        rid = request_id or f"req{self.metrics.counter('serving_requests_total').value:.0f}"
+        rid = request_id if request_id is not None \
+            else f"req{self._rid_counter}"
+        self._rid_counter += 1
         req = ServingRequest(id=str(rid), prompt=[int(t) for t in prompt],
                              max_new_tokens=int(max_new_tokens),
                              callback=callback, submitted_at=time.monotonic())
         self.metrics.counter("serving_requests_total").inc()
+        self.timelines.open(req.id).note(
+            "queued", prompt_len=len(req.prompt),
+            max_new=req.max_new_tokens)
         need_tokens = len(req.prompt) + req.max_new_tokens
         need_pages = self.allocator.pages_needed(need_tokens)
         if self.draining:
@@ -198,6 +358,10 @@ class ServingEngine:
         req.state, req.error = REFUSED, why
         req.finished_at = time.monotonic()
         self.metrics.counter("serving_requests_refused").inc()
+        tl = self.timelines.get(req.id)
+        if tl is not None:
+            tl.note("refused", why=why)
+            tl.state = "refused"
         flight.note("serving", "refuse", id=req.id, why=why)
         if req.callback:
             req.callback(req)
@@ -225,6 +389,8 @@ class ServingEngine:
             self._block_tables[slot, :need] = pages
             self._lens[slot] = -1  # joins the decode batch after prefill
             self._prefilling.append(req)
+            self.timelines.note(req.id, "admitted", slot=slot, pages=need,
+                                occupancy=self.allocator.occupancy())
             flight.note("serving", "admit", id=req.id, slot=slot,
                         pages=need)
 
@@ -249,12 +415,16 @@ class ServingEngine:
                 self.params, self.pool_k, self.pool_v, tokens, table,
                 np.int32(pos), np.int32(n_valid), self._next_rng())
             req.prefill_pos = pos + n_valid
+            self.timelines.note(req.id, "prefill_chunk",
+                                chunk=pos // max(sc.prefill_chunk, 1),
+                                tokens=n_valid)
             if req.prefill_pos >= len(req.prompt):
                 first = int(jax.device_get(tok)[0])
                 self._prefilling.popleft()
                 now = time.monotonic()
                 req.first_token_at = req.last_token_at = now
                 self.metrics.histogram("serving_ttft").record(req.ttft_s)
+                self.timelines.note(req.id, "first_token", token=first)
                 self._emit(req, first)
                 if req.state != FINISHED:
                     req.state = RUNNING
@@ -281,6 +451,8 @@ class ServingEngine:
                 self.metrics.histogram("serving_inter_token").record(
                     now - req.last_token_at)
                 req.last_token_at = now
+                self.timelines.note(req.id, "decode_tick",
+                                    pos=int(self._lens[req.slot]))
                 self._emit(req, tok)
                 if req.state != FINISHED:
                     self._last_tokens[req.slot] = tok
@@ -304,6 +476,12 @@ class ServingEngine:
         self._lens[slot] = -1
         self._last_tokens[slot] = 0
         self.metrics.counter("serving_requests_completed").inc()
+        tl = self.timelines.get(req.id)
+        if tl is not None:
+            tl.note("finished", new_tokens=len(req.tokens),
+                    pages_freed=len(req.pages),
+                    occupancy=self.allocator.occupancy())
+            tl.state = "finished"
         flight.note("serving", "finish", id=req.id,
                     new_tokens=len(req.tokens))
         if req.callback:
@@ -341,10 +519,34 @@ class ServingEngine:
             flight.note("serving", "drain",
                         active=sum(r is not None for r in self._slots),
                         queued=len(self._waiting))
+            # stamp the preemption onto every live timeline, then spill
+            # them into the flight ring: the post-mortem (and the router's
+            # merged trace) sees exactly where each request was when the
+            # reclaim landed
+            for tl in self.timelines.live():
+                tl.note("drain")
+            self.dump_timelines()
             logger.warning("serving engine draining: finishing %d in-flight "
                            "request(s)", sum(r is not None
                                              for r in self._slots)
                            + len(self._waiting))
+
+    def dump_timelines(self) -> int:
+        """Spill every live timeline into the flight ring (crash/drain
+        evidence for ``flight.dump``); returns how many were spilled."""
+        live = self.timelines.live()
+        for tl in live:
+            flight.note("serving_timeline", tl.id, state=tl.state,
+                        events=tl.events(), dropped=tl.ring.dropped,
+                        attribution=tl.attribution())
+        return len(live)
+
+    def request_trace(self, rid: str) -> Optional[dict]:
+        """The ``trace`` verb's payload for one request id: the bounded
+        event timeline + the phase attribution (None when the id is
+        unknown or already evicted from the timeline store)."""
+        tl = self.timelines.get(rid)
+        return tl.to_dict() if tl is not None else None
 
     # ------------------------------------------------------------- telemetry
     def reset_stats(self) -> None:
@@ -369,6 +571,7 @@ class ServingEngine:
         return used
 
     def _update_gauges(self) -> None:
+        self._gauges_current = True
         self.metrics.gauge("serving_queue_depth").set(len(self._waiting))
         self.metrics.gauge("serving_active_requests").set(
             sum(r is not None for r in self._slots))
@@ -384,28 +587,48 @@ class ServingEngine:
         ttft = m.histogram("serving_ttft").summary()
         itl = m.histogram("serving_inter_token").summary()
         tokens = m.counter("serving_tokens_total").value
-        return {
+        completed = int(m.counter("serving_requests_completed").value)
+        if self._gauges_current:
+            gauges = {
+                "queue_depth": int(m.gauge("serving_queue_depth").value),
+                "active_requests": int(
+                    m.gauge("serving_active_requests").value),
+                "page_occupancy": float(
+                    m.gauge("serving_page_occupancy").value),
+                "kv_fragmentation": float(
+                    m.gauge("serving_kv_fragmentation").value),
+                "scheduler_gauges": "ok",
+            }
+        else:
+            # this engine has never stepped: null + an explicit marker
+            # (the hbm_stats convention) instead of a fake-zero occupancy
+            gauges = {"queue_depth": None, "active_requests": None,
+                      "page_occupancy": None, "kv_fragmentation": None,
+                      "scheduler_gauges": "unavailable"}
+        snap = {
             "ts": time.time(),
             "scope": "serving",
             "schema_version": 2,
             "requests_admitted": int(
                 m.counter("serving_requests_total").value
                 - m.counter("serving_requests_refused").value),
-            "requests_completed": int(
-                m.counter("serving_requests_completed").value),
+            "requests_completed": completed,
             "requests_refused": int(
                 m.counter("serving_requests_refused").value),
-            "queue_depth": int(m.gauge("serving_queue_depth").value or 0),
-            "active_requests": int(
-                m.gauge("serving_active_requests").value or 0),
-            "page_occupancy": float(
-                m.gauge("serving_page_occupancy").value or 0.0),
-            "kv_fragmentation": float(
-                m.gauge("serving_kv_fragmentation").value or 0.0),
+            **gauges,
             "tokens_total": int(tokens),
             "tokens_per_sec": tokens / wall,
             "ttft_p50_s": ttft.get("p50"),
             "ttft_p99_s": ttft.get("p99"),
             "itl_p50_s": itl.get("p50"),
             "itl_p99_s": itl.get("p99"),
+            # full windowed summaries: the router pools these
+            # count-weighted into its fleet record
+            "ttft": ttft,
+            "itl": itl,
+            "chips": int(self.n_chips),
+            "requests_per_chip": completed / max(self.n_chips, 1),
         }
+        if self.slo is not None:
+            snap["slo_attainment"] = self.slo.observe(snap)["attainment"]
+        return snap

@@ -13,9 +13,24 @@ Placement policy: **least-outstanding** with round-robin tie-break — the
 cheapest estimator of per-replica queue depth that needs no backend
 cooperation (each replica already exports its own queue gauges).
 
+The router is also the fleet's observer (docs/serving.md
+"Observability"): it counts dispatches / re-dispatches / penalties /
+drain refusals, keeps a bounded per-request dispatch journal, and — when
+``--fleet-out`` is given — periodically polls every backend's ``stats``
+verb, merging the snapshots ``gang.merge_snapshots``-style (counters
+summed, TTFT/ITL pooled count-weighted with the worst replica
+attributed, fleet requests-per-chip) into ``FLEET_RECORD_SCHEMA``
+records appended to a JSONL sink. Its own front answers two verbs:
+``{"verb": "stats"}`` returns a fresh fleet record, and ``{"verb":
+"trace", "id": ...}`` merges the router journal with every live
+replica's timeline for that id — so a re-dispatched request's full story
+(dispatch → drain refusal → re-dispatch → lifecycle) reads as one
+time-sorted event list.
+
 This module deliberately imports no jax so ``python -m
 fleetx_tpu.serving.router`` starts in milliseconds — the router must come
-up before (and outlive) the replicas it fronts.
+up before (and outlive) the replicas it fronts. The observability
+imports it does take (schema, sinks) are stdlib-only.
 """
 
 from __future__ import annotations
@@ -24,7 +39,8 @@ import json
 import socket
 import threading
 import time
-from typing import Optional
+from collections import OrderedDict, deque
+from typing import Dict, Optional
 
 #: seconds a failed/draining backend is skipped before being retried
 #: (a supervisor restart needs a few seconds to bring the replica back)
@@ -33,6 +49,21 @@ PENALTY_S = 1.0
 #: total seconds the router keeps retrying one accepted request before
 #: answering "no backend" — covers a full supervisor restart cycle
 DISPATCH_DEADLINE_S = 120.0
+
+#: seconds between fleet stats sweeps when a fleet sink is configured
+DEFAULT_POLL_INTERVAL_S = 1.0
+
+#: timeout for one stats/trace side-channel round trip (read-only verbs
+#: answered at a step boundary — far faster than a generate request)
+VERB_TIMEOUT_S = 10.0
+
+#: fleet records carry the same version as serving snapshots
+FLEET_SCHEMA_VERSION = 2
+
+#: router-owned dispatch counters, merged into every fleet record
+ROUTER_COUNTERS = ("dispatched_total", "redispatched_total",
+                   "penalties_total", "drain_refusals_total",
+                   "no_backend_total", "completed_total")
 
 
 def _read_line(conn: socket.socket) -> bytes:
@@ -68,21 +99,156 @@ class Backend:
         self.failures += 1
 
 
+def _addr_str(addr: tuple) -> str:
+    """``(host, port)`` → the ``host:port`` replica label fleet records
+    and traces attribute to."""
+    return f"{addr[0]}:{addr[1]}"
+
+
+class RequestJournal:
+    """Bounded request-id → router-side dispatch events.
+
+    The router's half of a request's merged trace: which backend each
+    attempt went to, drain refusals, transport retries, completion.
+    Insertion-ordered eviction over ``max_requests`` ids (the flight-ring
+    stance), each id's event list itself a bounded deque.
+    """
+
+    def __init__(self, max_requests: int = 1024,
+                 events_per_request: int = 64):
+        self.max_requests = max(int(max_requests), 1)
+        self.events_per_request = max(int(events_per_request), 8)
+        self._lock = threading.Lock()
+        self._events: "OrderedDict[str, deque]" = OrderedDict()
+
+    def note(self, rid, name: str, **data) -> None:
+        """Append one router event for ``rid`` (None ids are unjournaled:
+        the reply still reaches the client, there is just no trace key)."""
+        if rid is None:
+            return
+        evt = {**data, "t": time.time(), "name": name, "source": "router"}
+        with self._lock:
+            evts = self._events.get(str(rid))
+            if evts is None:
+                evts = deque(maxlen=self.events_per_request)
+                self._events[str(rid)] = evts
+                while len(self._events) > self.max_requests:
+                    self._events.popitem(last=False)
+            evts.append(evt)
+
+    def events(self, rid) -> list:
+        """Copy of one id's journal (empty list when unknown/evicted)."""
+        with self._lock:
+            return list(self._events.get(str(rid)) or ())
+
+
+def merge_fleet_snapshots(snaps: Dict[str, dict], replicas_total: int,
+                          router_counters: Optional[dict] = None) -> dict:
+    """N per-replica ``serving_snapshot()`` dicts → one fleet record.
+
+    The serving-side twin of ``observability/gang.py:_merge_window``:
+    monotonic counters are summed, the TTFT/ITL histogram summaries are
+    pooled count-weighted (fleet mean) with the tail taken from — and
+    attributed to — the worst replica, occupancy is averaged AND max'd
+    with attribution, and requests-per-chip divides fleet completions by
+    fleet chips. ``snaps`` maps replica label → snapshot; replicas that
+    failed to report simply aren't in it (``replicas_reported`` records
+    the actual coverage). Gauges that are null on a replica (scheduler
+    gauges "unavailable") contribute nothing rather than a fake zero.
+    The shape is ``observability/schema.py:FLEET_RECORD_SCHEMA``.
+    """
+    replicas = sorted(snaps)
+
+    def _sum_int(key: str) -> int:
+        return int(sum(int(snaps[r].get(key) or 0) for r in replicas))
+
+    def _present(key: str) -> Dict[str, float]:
+        return {r: snaps[r][key] for r in replicas
+                if isinstance(snaps[r].get(key), (int, float))
+                and not isinstance(snaps[r].get(key), bool)}
+
+    record: dict = {
+        "ts": max([float(snaps[r].get("ts") or 0.0) for r in replicas],
+                  default=time.time()),
+        "scope": "fleet",
+        "schema_version": FLEET_SCHEMA_VERSION,
+        "replicas_total": int(replicas_total),
+        "replicas_reported": len(replicas),
+        "requests_admitted": _sum_int("requests_admitted"),
+        "requests_completed": _sum_int("requests_completed"),
+        "requests_refused": _sum_int("requests_refused"),
+        "tokens_total": _sum_int("tokens_total"),
+        "tokens_per_sec": sum(_present("tokens_per_sec").values())
+        if replicas else None,
+    }
+    chips = sum(int(snaps[r].get("chips") or 1) for r in replicas)
+    record["chips_total"] = chips
+    record["requests_per_chip"] = \
+        (record["requests_completed"] / chips) if chips else None
+    qd = _present("queue_depth")
+    record["queue_depth"] = int(sum(qd.values())) if qd else None
+    ar = _present("active_requests")
+    record["active_requests"] = int(sum(ar.values())) if ar else None
+    occ = _present("page_occupancy")
+    if occ:
+        record["page_occupancy_mean"] = sum(occ.values()) / len(occ)
+        worst = max(occ, key=lambda r: occ[r])
+        record["page_occupancy_max"] = float(occ[worst])
+        record["page_occupancy_max_replica"] = worst
+    for name in ("ttft", "itl"):
+        hists = {r: snaps[r].get(name) or {} for r in replicas}
+        counts = {r: int(h.get("count") or 0) for r, h in hists.items()}
+        total = sum(counts.values())
+        if not total:
+            continue
+        record[f"{name}_mean_s"] = sum(
+            float(hists[r].get("mean") or 0.0) * counts[r]
+            for r in replicas) / total
+        worst = max((r for r in replicas if counts[r]),
+                    key=lambda r: float(hists[r].get("p99") or 0.0))
+        record[f"{name}_p99_s"] = float(hists[worst].get("p99") or 0.0)
+        record[f"{name}_p99_replica"] = worst
+    att = _present("slo_attainment")
+    if att:
+        record["slo_attainment"] = min(att.values())
+    for name in ROUTER_COUNTERS:
+        if router_counters and name in router_counters:
+            record[name] = int(router_counters[name])
+    return record
+
+
 class Router:
     """Round-robin + least-outstanding front over the replica fleet."""
 
     def __init__(self, backends: list, host: str = "127.0.0.1",
-                 port: int = 0, request_timeout: float = 120.0):
+                 port: int = 0, request_timeout: float = 120.0,
+                 fleet_out: Optional[str] = None,
+                 poll_interval: float = DEFAULT_POLL_INTERVAL_S):
         self.backends = [Backend(h, p) for h, p in backends]
         assert self.backends, "router needs at least one backend"
         self.host = host
         self.port = int(port)
         self.request_timeout = float(request_timeout)
+        self.fleet_out = fleet_out
+        self.poll_interval = float(poll_interval)
         self._rr = 0
         self._lock = threading.Lock()
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
         self.retries = 0
+        self.counters = {name: 0 for name in ROUTER_COUNTERS}
+        self.journal = RequestJournal()
+        self.last_fleet: Optional[dict] = None
+        self._fleet_sink = None
+
+    def _count(self, name: str) -> None:
+        with self._lock:
+            self.counters[name] += 1
+
+    def router_counters(self) -> dict:
+        """Copy of the dispatch counters (merged into fleet records)."""
+        with self._lock:
+            return dict(self.counters)
 
     # ------------------------------------------------------------ placement
     def pick(self) -> Optional[Backend]:
@@ -109,12 +275,21 @@ class Router:
     def dispatch(self, payload: dict) -> dict:
         """Forward one request, re-dispatching across backends until a
         replica completes it or the deadline passes."""
+        rid = payload.get("id")
         deadline = time.monotonic() + DISPATCH_DEADLINE_S
+        attempts = 0
         while time.monotonic() < deadline:
             backend = self.pick()
             if backend is None:
                 time.sleep(0.05)  # whole fleet penalised — restart window
                 continue
+            addr = _addr_str(backend.addr)
+            attempts += 1
+            self._count("dispatched_total")
+            if attempts > 1:
+                self._count("redispatched_total")
+            self.journal.note(rid, "dispatch", backend=addr,
+                              attempt=attempts)
             try:
                 resp = self._forward(backend, payload)
             except (OSError, ValueError):
@@ -123,6 +298,8 @@ class Router:
                 # not complete the request": penalise and re-dispatch
                 backend.penalize(time.monotonic())
                 self.retries += 1
+                self._count("penalties_total")
+                self.journal.note(rid, "transport_retry", backend=addr)
                 continue
             finally:
                 self._release(backend)
@@ -131,20 +308,100 @@ class Router:
                 # retry the request elsewhere, losing nothing
                 backend.penalize(time.monotonic())
                 self.retries += 1
+                self._count("penalties_total")
+                self._count("drain_refusals_total")
+                self.journal.note(rid, "drain_refusal", backend=addr)
                 continue
+            self._count("completed_total")
+            self.journal.note(rid, "completed", backend=addr,
+                              error=resp.get("error"))
             return resp
-        return {"id": payload.get("id"), "error": "no backend available"}
+        self._count("no_backend_total")
+        self.journal.note(rid, "no_backend")
+        return {"id": rid, "error": "no backend available"}
 
     def _forward(self, backend: Backend, payload: dict) -> dict:
-        with socket.create_connection(backend.addr,
-                                      timeout=self.request_timeout) as conn:
+        return self._ask(backend.addr, payload,
+                         timeout=self.request_timeout)
+
+    def _ask(self, addr: tuple, payload: dict,
+             timeout: float = VERB_TIMEOUT_S) -> dict:
+        """One JSON-line round trip (``OSError``/``ValueError`` on
+        transport failure or a torn line — callers decide the retry)."""
+        with socket.create_connection(addr, timeout=timeout) as conn:
             conn.sendall((json.dumps(payload) + "\n").encode("utf-8"))
-            conn.settimeout(self.request_timeout)
+            conn.settimeout(timeout)
             buf = _read_line(conn)
         if not buf.strip():
-            raise ConnectionError(f"empty response from {backend.addr}")
+            raise ConnectionError(f"empty response from {addr}")
         # a torn line (replica died mid-write) raises ValueError → retry
         return json.loads(buf.decode("utf-8"))
+
+    # --------------------------------------------------------------- verbs
+    def poll_fleet(self) -> dict:
+        """One ``stats`` sweep over the backends → a merged fleet record.
+
+        Partial coverage is tolerated by construction: a draining or
+        crashed replica just doesn't report this window, and
+        ``replicas_reported`` says so.
+        """
+        snaps: Dict[str, dict] = {}
+        for backend in self.backends:
+            addr = _addr_str(backend.addr)
+            try:
+                resp = self._ask(backend.addr, {"verb": "stats"})
+            except (OSError, ValueError):
+                continue
+            if not isinstance(resp, dict) or resp.get("error"):
+                continue
+            snaps[addr] = resp
+        record = merge_fleet_snapshots(
+            snaps, replicas_total=len(self.backends),
+            router_counters=self.router_counters())
+        self.last_fleet = record
+        return record
+
+    def trace(self, rid: str) -> dict:
+        """Merge the router journal with every live replica's timeline
+        for one id, time-sorted — the fleet view of where the request's
+        latency went, drain refusals and re-dispatches included."""
+        events = self.journal.events(rid)
+        sources = ["router"] if events else []
+        attribution = None
+        for backend in self.backends:
+            try:
+                resp = self._ask(backend.addr,
+                                 {"verb": "trace", "id": rid})
+            except (OSError, ValueError):
+                continue  # draining/crashed replica: its half is gone
+            if resp.get("error") or not isinstance(resp.get("events"),
+                                                   list):
+                continue
+            addr = _addr_str(backend.addr)
+            events.extend({**e, "source": addr} for e in resp["events"])
+            sources.append(addr)
+            if isinstance(resp.get("attribution"), dict):
+                attribution = resp["attribution"]
+        if not events:
+            return {"id": rid, "error": "unknown request id"}
+        events.sort(key=lambda e: e.get("t") or 0.0)
+        out = {"id": rid, "events": events, "sources": sources}
+        if attribution is not None:
+            out["attribution"] = attribution
+        return out
+
+    def _poll_loop(self) -> None:
+        from fleetx_tpu.observability.schema import validate_fleet_record
+
+        while not self._stop.wait(self.poll_interval):
+            record = self.poll_fleet()
+            problems = validate_fleet_record(record)
+            if problems:  # a merge bug must not poison the JSONL stream
+                print(f"[router] dropping invalid fleet record: "
+                      f"{problems}", flush=True)
+                continue
+            if self._fleet_sink is not None:
+                self._fleet_sink.emit(record)
 
     # -------------------------------------------------------------- serving
     def start(self) -> int:
@@ -156,6 +413,14 @@ class Router:
         self.port = self._listener.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="router-accept").start()
+        if self.fleet_out:
+            # stdlib-only sink reuse (sinks.py imports jax lazily now):
+            # the fleet stream is line-buffered JSONL like every other
+            from fleetx_tpu.observability.sinks import JsonlSink
+
+            self._fleet_sink = JsonlSink(self.fleet_out)
+            threading.Thread(target=self._poll_loop, daemon=True,
+                             name="router-fleet-poll").start()
         return self.port
 
     def _accept_loop(self) -> None:
@@ -174,7 +439,14 @@ class Router:
             if not buf.strip():
                 return
             payload = json.loads(buf.decode("utf-8"))
-            resp = self.dispatch(payload)
+            verb = payload.get("verb") if isinstance(payload, dict) \
+                else None
+            if verb == "stats":
+                resp = self.poll_fleet()
+            elif verb == "trace":
+                resp = self.trace(str(payload.get("id")))
+            else:
+                resp = self.dispatch(payload)
             conn.sendall((json.dumps(resp) + "\n").encode("utf-8"))
         except (OSError, ValueError):
             pass  # client went away / bad JSON — nothing to answer
@@ -185,13 +457,19 @@ class Router:
                 pass
 
     def close(self) -> None:
-        """Tear down the front listener."""
+        """Tear down the front listener and the fleet sink."""
         self._stop.set()
         if self._listener is not None:
             try:
                 self._listener.close()
             except OSError:
                 pass
+        if self._fleet_sink is not None:
+            try:
+                self._fleet_sink.close()
+            except OSError:
+                pass
+            self._fleet_sink = None
 
 
 def main(argv=None) -> int:
@@ -203,15 +481,25 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--backends", required=True,
                     help="comma-separated host:port replica list")
+    ap.add_argument("--fleet-out", default=None,
+                    help="append merged fleet records (JSONL, "
+                         "FLEET_RECORD_SCHEMA) to this path")
+    ap.add_argument("--poll-interval", type=float,
+                    default=DEFAULT_POLL_INTERVAL_S,
+                    help="seconds between backend stats sweeps")
     args = ap.parse_args(argv)
     backends = []
     for spec in args.backends.split(","):
         h, _, p = spec.strip().rpartition(":")
         backends.append((h or "127.0.0.1", int(p)))
-    router = Router(backends, host=args.host, port=args.port)
+    router = Router(backends, host=args.host, port=args.port,
+                    fleet_out=args.fleet_out,
+                    poll_interval=args.poll_interval)
     port = router.start()
     print(f"[router] listening on {args.host}:{port} over "
-          f"{len(backends)} backend(s)", flush=True)
+          f"{len(backends)} backend(s)"
+          + (f", fleet records → {args.fleet_out}" if args.fleet_out
+             else ""), flush=True)
     try:
         while True:
             time.sleep(1.0)

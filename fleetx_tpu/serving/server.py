@@ -6,10 +6,18 @@ Wire protocol (one request per connection, newline-delimited JSON)::
     ← {"id": "r1", "tokens": [41, 3, ...], "ttft_s": 0.01, "latency_s": 0.2}
     ← {"id": "r1", "error": "draining"}          # replica is being reclaimed
 
+Two read-only **verbs** ride the same protocol (docs/serving.md
+"Observability") — the router polls the first, operators ask the second::
+
+    → {"verb": "stats"}                    ← one serving_snapshot() record
+    → {"verb": "trace", "id": "r1"}        ← the request's lifecycle
+                                             timeline + phase attribution
+
 The engine loop stays on the caller's (main) thread — connection handler
-threads only enqueue submissions and wait on completion events, so all
-device work is single-threaded and the PR 4/6 ``PreemptionHandler`` can be
-installed normally. On a latched preemption the replica **drains**: new
+threads only enqueue submissions (and verb thunks, which the loop services
+at every step boundary) and wait on completion events, so all device work
+AND all engine-state reads are single-threaded and the PR 4/6
+``PreemptionHandler`` can be installed normally. On a latched preemption the replica **drains**: new
 requests are answered ``"draining"`` (the router re-dispatches them),
 in-flight decodes run to completion, and ``run()`` returns so
 ``tools/serve.py`` can exit with the preemption code — the supervisor then
@@ -78,6 +86,7 @@ class ReplicaServer:
         self.port = int(port)
         self.fault_plan = fault_plan
         self._submissions: queue.Queue = queue.Queue()
+        self._control: queue.Queue = queue.Queue()
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
 
@@ -109,7 +118,14 @@ class ReplicaServer:
         wait for completion, answer."""
         try:
             msg = read_json_line(conn, REQUEST_TIMEOUT_S)
-            if not msg or "prompt" not in msg:
+            if not isinstance(msg, dict):
+                send_json_line(conn, {"error": "bad request"})
+                return
+            verb = msg.get("verb")
+            if verb in ("stats", "trace"):
+                send_json_line(conn, self._control_call(verb, msg))
+                return
+            if "prompt" not in msg:
                 send_json_line(conn, {"error": "bad request"})
                 return
             if self.engine.draining:
@@ -146,7 +162,44 @@ class ReplicaServer:
             except OSError:
                 pass
 
+    def _control_call(self, verb: str, msg: dict,
+                      timeout: float = 30.0) -> dict:
+        """Run one read-only verb on the engine thread.
+
+        The loop services the control queue at every step boundary (and
+        through the drain grace window), so snapshots and timeline reads
+        never race a scheduler step mutating histograms/slot state.
+        """
+        done = threading.Event()
+        box: dict = {}
+
+        def run() -> None:
+            try:
+                if verb == "stats":
+                    box["resp"] = self.engine.serving_snapshot()
+                else:
+                    rid = str(msg.get("id"))
+                    tr = self.engine.request_trace(rid)
+                    box["resp"] = tr if tr is not None else \
+                        {"id": rid, "error": "unknown request id"}
+            except Exception as e:  # noqa: BLE001 — answer, don't kill the loop
+                box["resp"] = {"error": f"{type(e).__name__}: {e}"}
+            done.set()
+
+        self._control.put(run)
+        if not done.wait(timeout):
+            return {"error": "control timeout"}
+        return box["resp"]
+
     # ----------------------------------------------------------------- loop
+    def _serve_control(self) -> None:
+        while True:
+            try:
+                fn = self._control.get_nowait()
+            except queue.Empty:
+                return
+            fn()
+
     def _drain_submissions(self) -> None:
         while True:
             try:
@@ -167,6 +220,7 @@ class ReplicaServer:
                     not self.engine.draining:
                 self.engine.begin_drain()
             self._drain_submissions()
+            self._serve_control()
             worked = self.engine.step()
             if worked:
                 work_steps += 1
@@ -188,6 +242,7 @@ class ReplicaServer:
         grace_deadline = time.monotonic() + 0.5
         while time.monotonic() < grace_deadline:
             self._drain_submissions()
+            self._serve_control()
             time.sleep(0.02)
         flight.note("serving", "drained", steps=work_steps)
         logger.warning("serving replica drained after %d work steps",

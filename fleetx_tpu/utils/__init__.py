@@ -1,2 +1,20 @@
-from fleetx_tpu.utils import config, env, log  # noqa: F401
-from fleetx_tpu.utils.log import logger  # noqa: F401
+"""Shared utilities: config parsing, env probes, logging.
+
+Submodules resolve lazily (PEP 562): ``config`` pulls the partition-rule
+registry (and through it jax), which jax-free consumers — the serving
+router, the observability sinks it reuses, AST-only lint — must not pay
+for just to get ``log``.
+"""
+
+__all__ = ["config", "env", "log", "logger"]
+
+
+def __getattr__(name: str):
+    """Lazy submodule/attr exports (keeps ``utils.log`` users jax-free)."""
+    import importlib
+
+    if name in ("config", "env", "log"):
+        return importlib.import_module(f"{__name__}.{name}")
+    if name == "logger":
+        return importlib.import_module(f"{__name__}.log").logger
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -330,6 +330,30 @@ def process_resilience_config(config: AttrDict) -> AttrDict:
     return config
 
 
+def process_serving_config(config: AttrDict) -> AttrDict:
+    """Eagerly validate the ``Serving`` block (docs/serving.md).
+
+    Same stance as the observability/resilience processors: defaults live
+    in ONE place (``serving.engine.ServingConfig``); this only validates
+    what a typo would otherwise surface at the worst moment — the SLO
+    block fails at launch instead of when the first attainment window
+    closes, and zero-capacity trace rings would silently record nothing.
+    """
+    serving = config.get("Serving")
+    if not serving:
+        return config
+    # import inside: keeps this module's import surface flat (slo.py pulls
+    # the metrics registry, not needed by pure config consumers)
+    from fleetx_tpu.observability.slo import validate_slo_block
+
+    validate_slo_block(serving.get("slo"))
+    for key in ("trace_requests", "trace_events"):
+        v = serving.get(key)
+        if v is not None and int(v) <= 0:
+            raise ValueError(f"Serving.{key} must be > 0, got {v!r}")
+    return config
+
+
 def get_config(fname: str, overrides: list[str] | None = None, show: bool = False,
                num_devices: int | None = None, auto_layout: bool = False) -> AttrDict:
     """Load + override + post-process a config (reference ``config.py:313-345``).
@@ -389,6 +413,7 @@ def get_config(fname: str, overrides: list[str] | None = None, show: bool = Fals
     process_engine_config(config)
     process_observability_config(config)
     process_resilience_config(config)
+    process_serving_config(config)
     if show:
         print_config(config)
     return config

@@ -582,6 +582,18 @@ def test_replica_drains_on_injected_sigterm(tmp_path):
         assert flights, "no flight dump after drain"
         events = json.loads(flights[0].read_text())["events"]
         assert any(e.get("name") == "drain" for e in events)
+        # ...and the drain spilled every live request TIMELINE alongside
+        # the engine events — the postmortem can reconstruct exactly where
+        # each in-flight request was when the preemption latch fired
+        timelines = [e for e in events
+                     if e.get("kind") == "serving_timeline"]
+        assert timelines, "drain dumped no request timelines"
+        for tl in timelines:
+            assert tl["name"].startswith("d"), tl  # the drill's ids
+            names = [ev["name"] for ev in tl["events"]]
+            assert "drain" in names, (tl["name"], names)
+            if "admitted" in names:  # queued-only requests have no span yet
+                assert tl["attribution"]["queue_s"] is not None, tl
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -660,6 +672,21 @@ def test_supervised_fleet_kill_one_replica_loses_nothing(tmp_path):
         for i, resp in enumerate(results):
             assert resp is not None, f"request {i} lost"
             assert resp.get("tokens") == want[i], (i, resp, want[i])
+
+        # a completed request's lifecycle is retrievable THROUGH the
+        # router: its dispatch journal merged (time-sorted) with whatever
+        # replica still holds the timeline — the restarted replica lost
+        # its half, which must degrade the trace, not error it
+        tr = _ask(router_port, {"verb": "trace", "id": "f9"})
+        assert tr.get("events"), tr
+        names = [e["name"] for e in tr["events"]]
+        assert "dispatch" in names and "completed" in names, names
+        assert "router" in tr["sources"]
+        ts = [e["t"] for e in tr["events"]]
+        assert ts == sorted(ts)
+        # an id nobody ever saw answers an explicit error, not a hang
+        miss = _ask(router_port, {"verb": "trace", "id": "never"})
+        assert miss.get("error") == "unknown request id", miss
 
         # graceful fleet shutdown: the surviving replica's supervisor
         # forwards SIGTERM → drain → preemption code (treated clean)

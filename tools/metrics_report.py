@@ -26,6 +26,14 @@ per-rank records would produce a summary describing neither run.
 as the ``BENCH_*.json`` result entries (tokens/s value + step time + MFU),
 and ``--compare FILE:KEY`` diffs the run's throughput against a committed
 ``BENCH_*.json`` entry.
+
+Serving streams (docs/serving.md "Observability") report here too: the
+tool sniffs each file's ``scope`` field and dispatches — replica snapshot
+files (``scope: "serving"``, from ``tools/serve.py --metrics-out``)
+validate against ``SERVING_RECORD_SCHEMA``, router fleet files
+(``scope: "fleet"``, from ``--fleet-out``) against
+``FLEET_RECORD_SCHEMA`` — each with its own summary table. Mixing scopes
+in one invocation is REFUSED for the same reason schema versions are.
 """
 
 import argparse
@@ -38,7 +46,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from fleetx_tpu.observability.gang import merge_rank_records  # noqa: E402
 from fleetx_tpu.observability.schema import (  # noqa: E402
-    record_schema_version, validate_jsonl)
+    record_schema_version, validate_fleet_record, validate_jsonl,
+    validate_record, validate_serving_record)
 
 
 def _stats(values):
@@ -109,6 +118,130 @@ def print_table(summary: dict) -> None:
         print(f"{label:<14} " + " ".join(f"{c:>12}" for c in cells))
 
 
+#: scope marker → (validator, sort key). Step records carry no serving
+#: scope (gang ones say "gang"/"rank", both step-shaped) and sort by step;
+#: the serving streams are time series and sort by ts.
+_SCOPE_STREAMS = {
+    "serving": (validate_serving_record, "ts"),
+    "fleet": (validate_fleet_record, "ts"),
+}
+
+
+def sniff_scope(path: str) -> str:
+    """First parsable record's stream kind: "step", "serving" or "fleet".
+
+    Unparsable/empty files sniff as "step" — the step-record validator
+    then reports the real problem with line numbers.
+    """
+    try:
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    return "step"
+                scope = rec.get("scope") if isinstance(rec, dict) else None
+                return scope if scope in _SCOPE_STREAMS else "step"
+    except OSError:
+        pass
+    return "step"
+
+
+def summarize_serving(records: list[dict]) -> dict:
+    """Aggregate replica serving snapshots (counters are cumulative —
+    last wins; gauges/quantiles get the usual mean/min/max/last)."""
+    last = records[-1]
+    wall = (records[-1]["ts"] - records[0]["ts"]) if len(records) > 1 else 0.0
+    return {
+        "scope": "serving",
+        "records": len(records),
+        "wall_s": round(wall, 3),
+        "requests_admitted": last["requests_admitted"],
+        "requests_completed": last["requests_completed"],
+        "requests_refused": last["requests_refused"],
+        "tokens_total": last["tokens_total"],
+        "tokens_per_sec": _stats([r.get("tokens_per_sec")
+                                  for r in records]),
+        "ttft_p99_s": _stats([r.get("ttft_p99_s") for r in records]),
+        "itl_p99_s": _stats([r.get("itl_p99_s") for r in records]),
+        "page_occupancy": _stats([r.get("page_occupancy")
+                                  for r in records]),
+        "requests_per_chip": _stats([r.get("requests_per_chip")
+                                     for r in records]),
+        "slo_attainment": _stats([r.get("slo_attainment")
+                                  for r in records]),
+    }
+
+
+def summarize_fleet(records: list[dict]) -> dict:
+    """Aggregate router fleet records; coverage tracks the worst window."""
+    last = records[-1]
+    wall = (records[-1]["ts"] - records[0]["ts"]) if len(records) > 1 else 0.0
+    return {
+        "scope": "fleet",
+        "records": len(records),
+        "wall_s": round(wall, 3),
+        "replicas_total": last["replicas_total"],
+        "replicas_reported_min": min(r["replicas_reported"]
+                                     for r in records),
+        "requests_admitted": last["requests_admitted"],
+        "requests_completed": last["requests_completed"],
+        "requests_refused": last["requests_refused"],
+        "tokens_total": last["tokens_total"],
+        "tokens_per_sec": _stats([r.get("tokens_per_sec")
+                                  for r in records]),
+        "ttft_p99_s": _stats([r.get("ttft_p99_s") for r in records]),
+        "itl_p99_s": _stats([r.get("itl_p99_s") for r in records]),
+        "requests_per_chip": _stats([r.get("requests_per_chip")
+                                     for r in records]),
+        "slo_attainment": _stats([r.get("slo_attainment")
+                                  for r in records]),
+        "redispatched_total": last.get("redispatched_total"),
+        "drain_refusals_total": last.get("drain_refusals_total"),
+    }
+
+
+_SERVING_ROWS = (
+    ("tokens_per_sec", "tokens/s", 1.0, "{:,.1f}"),
+    ("ttft_p99_s", "TTFT p99 (s)", 1.0, "{:.4f}"),
+    ("itl_p99_s", "ITL p99 (s)", 1.0, "{:.4f}"),
+    ("page_occupancy", "page occupancy", 100.0, "{:.1f}%"),
+    ("requests_per_chip", "req/chip", 1.0, "{:.2f}"),
+    ("slo_attainment", "SLO attainment", 100.0, "{:.2f}%"),
+)
+
+
+def print_serving_table(summary: dict) -> None:
+    """Render a serving or fleet summary as an aligned text table."""
+    head = [f"records: {summary['records']}",
+            f"wall: {summary['wall_s']:.1f}s",
+            f"admitted: {summary['requests_admitted']}",
+            f"completed: {summary['requests_completed']}",
+            f"refused: {summary['requests_refused']}"]
+    if summary["scope"] == "fleet":
+        head.insert(1, f"replicas: {summary['replicas_reported_min']}"
+                       f"(min)/{summary['replicas_total']}")
+    print("   ".join(head))
+    header = f"{'metric':<16} {'mean':>12} {'min':>12} {'max':>12} " \
+             f"{'last':>12}"
+    print(header)
+    print("-" * len(header))
+    for key, label, scale, fmt in _SERVING_ROWS:
+        st = summary.get(key)
+        if st is None:
+            print(f"{label:<16} {'—':>12} {'—':>12} {'—':>12} {'—':>12}")
+            continue
+        cells = [fmt.format(st[k] * scale)
+                 for k in ("mean", "min", "max", "last")]
+        print(f"{label:<16} " + " ".join(f"{c:>12}" for c in cells))
+    if summary["scope"] == "fleet" and \
+            summary.get("redispatched_total") is not None:
+        print(f"router: redispatched={summary['redispatched_total']}   "
+              f"drain_refusals={summary['drain_refusals_total']}")
+
+
 def compare(summary: dict, spec: str) -> int:
     """``FILE:KEY`` → diff mean tokens/s against the bench entry's value."""
     path, _, key = spec.partition(":")
@@ -174,10 +307,14 @@ def resolve_inputs(spec: str) -> tuple[list[str], str | None]:
     return matches, gang
 
 
-def _load_validated(path: str) -> tuple[list[dict] | None, int]:
+def _load_validated(path: str,
+                    scope: str = "step") -> tuple[list[dict] | None, int]:
     """Validate + parse one JSONL file; (records, rc) with rc != 0 on any
-    schema violation or an empty file (the bench-gate contract)."""
-    count, errors = validate_jsonl(path)
+    schema violation or an empty file (the bench-gate contract). The
+    ``scope`` picks the schema (step records by default)."""
+    validator, sort_key = _SCOPE_STREAMS.get(scope,
+                                             (validate_record, "step"))
+    count, errors = validate_jsonl(path, validator=validator)
     if errors:
         print(f"error: {path} failed schema validation "
               f"({len(errors)} problem(s) in {count} record(s)):",
@@ -190,7 +327,7 @@ def _load_validated(path: str) -> tuple[list[dict] | None, int]:
         return None, 1
     with open(path) as f:
         records = [json.loads(l) for l in f if l.strip()]
-    records.sort(key=lambda r: r["step"])
+    records.sort(key=lambda r: r[sort_key])
     return records, 0
 
 
@@ -237,6 +374,43 @@ def main(argv=None) -> int:
         print(f"error: {args.jsonl} matched no metrics JSONL",
               file=sys.stderr)
         return 2
+
+    scopes = {path: sniff_scope(path)
+              for path in files + ([gang_file] if gang_file else [])}
+    if len(set(scopes.values())) > 1:
+        print("error: mixed record scopes across inputs — refusing to "
+              "summarize unrelated streams:", file=sys.stderr)
+        for path, s in sorted(scopes.items()):
+            print(f"  {s}: {path}", file=sys.stderr)
+        return 2
+    scope = next(iter(scopes.values()))
+    if scope in _SCOPE_STREAMS:
+        # serving/fleet streams: validate each file against its schema,
+        # concatenate (multiple replica files are one time series) and
+        # render the serving table — no gang merge, no --compare
+        records: list = []
+        for path in files + ([gang_file] if gang_file else []):
+            recs, rc = _load_validated(path, scope=scope)
+            if rc:
+                return rc
+            records.extend(recs)
+        records.sort(key=lambda r: r["ts"])
+        summary = summarize_fleet(records) if scope == "fleet" \
+            else summarize_serving(records)
+        print(f"== {scope} stream")
+        print_serving_table(summary)
+        if args.json:
+            payload = json.dumps(summary, indent=1)
+            if args.json == "-":
+                print(payload)
+            else:
+                with open(args.json, "w") as f:
+                    f.write(payload + "\n")
+        if args.compare:
+            print("error: --compare applies to training step records only",
+                  file=sys.stderr)
+            return 2
+        return 0
 
     by_file: dict = {}
     for path in files + ([gang_file] if gang_file else []):

@@ -96,6 +96,14 @@ SERVING_METRICS = {
     "serving.itl_p50_s": ("lower", 0.25, 0.002),
     "serving.itl_p99_s": ("lower", 0.25, 0.005),
     "serving.refused": ("lower", 0.0, 0.5),  # abs: any new refusal fails
+    # fleet-economics rows (PR 16): completions per chip regress DOWN,
+    # page occupancy regressing DOWN means the batcher stopped packing the
+    # KV pool (with an absolute floor over tiny-bench noise), and SLO
+    # attainment carries a pure 2-point absolute band — a 0.99 → 0.96
+    # drop is a breached objective, not jitter. All skip-if-absent.
+    "serving.requests_per_chip": ("higher", 0.15, 0.0),
+    "serving.page_occupancy": ("higher", 0.15, 0.05),
+    "serving.slo_attainment": ("higher", 0.0, 0.02),
 }
 
 
@@ -271,6 +279,24 @@ def self_check(baseline_entry: dict) -> list[str]:
     rows = compare(drifted_ft, ft)
     for metric in ("finetune.adapter_step_time_s",
                    "finetune.trainable_params_frac"):
+        if not any(r["metric"] == metric and r["verdict"] == "FAIL"
+                   for r in rows):
+            problems.append(f"synthetic {metric} regression NOT caught")
+    # fleet-economics serving rows self-check on synthetic values (their
+    # real rows skip-if-absent on pre-fleet baselines): identical copies
+    # pass, a 30% requests-per-chip drop and a 0.99 → 0.90 attainment
+    # drop must both fail
+    sv = dict(baseline_entry)
+    sv["serving"] = {"requests_per_chip": 4.0, "page_occupancy": 0.6,
+                     "slo_attainment": 0.99}
+    rows = compare(json.loads(json.dumps(sv)), sv)
+    if any(r["verdict"] == "FAIL" for r in rows):
+        problems.append("identical fleet serving rows flagged as regression")
+    drifted_sv = json.loads(json.dumps(sv))
+    drifted_sv["serving"]["requests_per_chip"] = 2.8
+    drifted_sv["serving"]["slo_attainment"] = 0.90
+    rows = compare(drifted_sv, sv)
+    for metric in ("serving.requests_per_chip", "serving.slo_attainment"):
         if not any(r["metric"] == metric and r["verdict"] == "FAIL"
                    for r in rows):
             problems.append(f"synthetic {metric} regression NOT caught")

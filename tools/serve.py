@@ -192,6 +192,11 @@ def main(argv=None) -> int:
                     help="run the request router instead of a replica")
     ap.add_argument("--backends", default=None,
                     help="router mode: comma-separated host:port replicas")
+    ap.add_argument("--fleet-out", default=None,
+                    help="router mode: append merged fleet snapshots "
+                         "(FLEET_RECORD_SCHEMA JSONL) here")
+    ap.add_argument("--poll-interval", type=float, default=1.0,
+                    help="router mode: seconds between backend stats polls")
     ap.add_argument("--bench", action="store_true",
                     help="run the Poisson serving bench and exit")
     ap.add_argument("--requests", type=int, default=0,
@@ -208,17 +213,24 @@ def main(argv=None) -> int:
 
         if not args.backends:
             ap.error("--router requires --backends host:port,host:port")
-        return router_main(["--port", str(args.port), "--host", args.host,
-                            "--backends", args.backends])
+        router_argv = ["--port", str(args.port), "--host", args.host,
+                       "--backends", args.backends,
+                       "--poll-interval", str(args.poll_interval)]
+        if args.fleet_out:
+            router_argv += ["--fleet-out", args.fleet_out]
+        return router_main(router_argv)
 
     if not args.config:
         ap.error("replica/bench mode requires -c config.yaml")
     from fleetx_tpu.utils import config as config_mod
 
     # parse + override only: the training post-processing (batch-size
-    # derivations, LR math) has no meaning for a serving process
+    # derivations, LR math) has no meaning for a serving process — but the
+    # Serving block itself (slo targets, trace knobs) validates eagerly so
+    # a typo'd SLO key fails at launch, not at the first snapshot
     cfg = config_mod.parse_config(args.config)
     config_mod.override_config(cfg, args.override)
+    config_mod.process_serving_config(cfg)
     if args.bench:
         return _run_bench(args, cfg)
     return _run_replica(args, cfg)
