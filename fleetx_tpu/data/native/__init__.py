@@ -36,7 +36,7 @@ class _IndexBuilder:
             src = os.path.join(_DIR, "index_builder.cpp")
             if not os.path.exists(_SO) or (
                     os.path.getmtime(_SO) < os.path.getmtime(src)):
-                subprocess.check_call(
+                subprocess.check_call(  # fleetx: noqa[FX016] -- serialising the first-use compile IS the lock's job: concurrent loaders must block here rather than race make / dlopen a half-written .so
                     ["make", "-C", _DIR], stdout=subprocess.DEVNULL)
             lib = ctypes.CDLL(_SO)
             lib.build_sample_idx.argtypes = [

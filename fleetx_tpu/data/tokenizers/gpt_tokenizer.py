@@ -142,7 +142,7 @@ class GPTTokenizer:
                 break
             pairs = get_pairs(word)
         out = " ".join(word)
-        self.cache[token] = out
+        self.cache[token] = out  # fleetx: noqa[FX014] -- idempotent memo write: BPE is deterministic per token, the GIL keeps the dict store atomic, and a lost race costs one recompute — a cache lock would serialise every handler thread
         return out
 
     def encode(self, text: str) -> list[int]:

@@ -6,13 +6,17 @@ Two granularities, matching the two rule scopes in ``core.Rule``:
   only on one file's text plus a small stable context (FX004's mesh
   axes).  Their findings are cached per
   ``(relpath, sha1(text), rule, context_key)``.
-- **project-scope** rules (FX006-FX009, FX011/FX012) read cross-file
-  state — the config zoo, the call graph over ``fleetx_tpu/`` +
-  ``tools/`` + ``tasks/``.  Their findings are cached against
+- **project-scope** rules (FX006-FX009, FX011/FX012, FX014-FX016) read
+  cross-file state — the config zoo, the call graph over ``fleetx_tpu/``
+  + ``tools/`` + ``tasks/``.  Their findings are cached against
   ``Rule.project_digest`` — the whole-project content digest by default
-  (any file change re-runs them), or a narrower dependency fingerprint
-  for the expensive shardcheck audit (registry + models + configs —
-  ``lint/rules/sharding.py``) so unrelated code edits keep it warm.
+  (any file change re-runs them), or a narrower dependency fingerprint:
+  the expensive shardcheck audit keys on registry + models + configs
+  (``lint/rules/sharding.py``) so unrelated code edits keep it warm, and
+  the thread rules key on the call-graph fingerprint — every scanned /
+  context python file, config zoo excluded (``lint/rules/threads.py::
+  callgraph_fingerprint``) — so a cross-file edit that moves a helper
+  under a lock invalidates correctly while YAML-only edits stay warm.
 
 Cached findings are raw: fingerprints, ``noqa`` suppression and baseline
 filtering are recomputed on every run (they read current line text), so a

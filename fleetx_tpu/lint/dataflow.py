@@ -715,3 +715,628 @@ def get_dataflow(project) -> Dataflow:
     if cached is None:
         cached = project._lint_dataflow = Dataflow(project)
     return cached
+
+
+# ------------------------------------------------- thread/lock lattice
+#
+# The serving fleet (PRs 10-16) runs a genuinely concurrent runtime: the
+# engine loop, the replica accept/handler threads and the router's
+# accept/poll/per-connection threads all share mutable state behind ad-hoc
+# ``threading.Lock`` discipline.  ``ThreadModel`` extends the call graph
+# above with the three facts the FX014-FX016 rules (rules/threads.py) need:
+#
+# 1. a **runs-on context** per function: thread roots are inferred from
+#    ``threading.Thread(target=...)`` call sites (the ``name=`` literal is
+#    the context label) and propagated over call edges to a fixpoint.  A
+#    context is *multi* when its spawn sits in a loop (per-connection
+#    handlers) or its spawner itself runs multiply — two instances of the
+#    same multi context race each other;
+# 2. a **guarded-attribute map** per class: lock attributes (assigned
+#    ``threading.Lock()``/``RLock()``/``tsan.lock()``) and, for every
+#    ``self.<attr>`` access, the lock set held — lexically (``with
+#    self._lock:``) plus the *intersection* of locks held by all callers
+#    (so a helper only ever invoked under the lock counts as guarded);
+# 3. **lock acquisition order** and **may-block summaries**, both
+#    interprocedural, for the inversion (FX015) and drain-stall (FX016)
+#    shapes.
+#
+# Everything stays a *may* analysis: receiver-typed calls (``backend.
+# penalize(...)``) resolve through a unique-method-name fallback (classic
+# CHA shortcut) guarded by a blocklist of ubiquitous names, and dynamic
+# hand-offs (callables through queues, executors) are invisible —
+# documented in docs/static_analysis.md "Scope and limits".
+
+THREAD_FACTORIES = {"threading.Thread"}
+
+#: constructors whose result IS a lock (``with`` on the attr guards state);
+#: tsan.lock is the sanitizer-wrapped factory the serving locks use
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock",
+    "fleetx_tpu.observability.tsan.lock",
+}
+
+#: constructors whose instances synchronize internally — attribute traffic
+#: on them is exempt from FX014 (the lock-free-queue / Event FP guards);
+#: deque append/popleft are atomic per CPython's documented guarantees
+THREADSAFE_FACTORIES = LOCK_FACTORIES | {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "collections.deque", "threading.Event",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier", "threading.local",
+}
+
+#: receiver methods that mutate a container in place — ``self._waiting.
+#: append(x)`` is a WRITE to the attribute's object, not a read
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort", "reverse",
+}
+
+#: attribute calls that (may) block the calling thread
+BLOCKING_ATTR_CALLS = {"recv", "recvfrom", "recv_into", "accept",
+                       "communicate", "wait"}
+
+#: resolved call targets that (may) block
+BLOCKING_CALLS = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "socket.create_connection", "time.sleep",
+    "select.select", "jax.block_until_ready", "jax.device_get",
+}
+
+#: names too ubiquitous for unique-method-name call resolution — binding
+#: ``pool.submit`` or ``sock.close`` to whatever single project method
+#: happens to share the name would wire bogus context edges
+UNIQUE_METHOD_BLOCKLIST = {
+    "get", "put", "set", "add", "append", "pop", "update", "clear",
+    "close", "start", "stop", "run", "join", "wait", "send", "recv",
+    "read", "write", "open", "submit", "items", "keys", "values", "copy",
+    "count", "index", "insert", "remove", "sort", "reverse", "flush",
+    "reset", "acquire", "release", "result", "done", "cancel", "name",
+    "step", "next", "save", "load", "extend", "discard", "setdefault",
+    "main", "check", "emit", "fit", "eval",
+}
+
+#: the implicit context of everything reachable from a non-thread entry
+MAIN_CONTEXT = "main"
+
+#: methods whose self-attr writes happen before the object is shared
+INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    """One lock identity: a ``self.<attr>`` lock keyed by class (instance-
+    insensitive — two Routers conflate, a deliberate over-approximation)
+    or a module-level lock keyed by file."""
+
+    relpath: str
+    cls: Optional[str]
+    attr: str
+
+    @property
+    def label(self) -> str:
+        return f"{self.cls or self.relpath}.{self.attr}"
+
+
+@dataclasses.dataclass
+class ThreadSpawn:
+    """One ``threading.Thread(target=...)`` site resolved to a project
+    function."""
+
+    label: str            # name= literal, else "thread:<qualname>"
+    multi: bool           # spawned inside a loop → many live instances
+    spawner: FuncInfo
+    stmt: ast.stmt
+    target: FuncInfo
+    lineno: int
+
+
+@dataclasses.dataclass
+class AttrAccess:
+    """One ``self.<attr>`` read/write inside a class's methods."""
+
+    owner: Tuple[str, str]        # (relpath, class name)
+    attr: str
+    kind: str                     # "read" | "write"
+    rmw: bool                     # read-modify-write (+=, container mutator)
+    func: FuncInfo
+    stmt: ast.stmt
+    lineno: int
+    col: int
+    lexical_locks: frozenset      # LockIds held by enclosing `with` frames
+
+
+@dataclasses.dataclass
+class LockPair:
+    """``second`` acquired while ``first`` is held (lexical nesting or a
+    call made under ``first`` into a function that acquires ``second``)."""
+
+    first: LockId
+    second: LockId
+    relpath: str
+    lineno: int
+    in_scope: bool
+    via: Optional[str] = None     # callee chain for interprocedural pairs
+
+
+@dataclasses.dataclass
+class BlockingSite:
+    """A (may-)blocking call reachable while a lock is held."""
+
+    lock: LockId
+    desc: str
+    relpath: str
+    lineno: int
+    col: int
+    in_scope: bool
+
+
+class ThreadModel:
+    """Thread-context + lock-discipline facts over one project."""
+
+    def __init__(self, df: Dataflow):
+        self.df = df
+        self.lock_attrs: Dict[Tuple[str, Optional[str]], Set[str]] = {}
+        self.safe_attrs: Dict[Tuple[str, str], Set[str]] = {}
+        self.module_locks: Dict[str, Set[str]] = {}
+        self.spawns: List[ThreadSpawn] = []
+        self.spawns_by_func: Dict[int, List[ThreadSpawn]] = {}
+        self.accesses: Dict[Tuple[str, str],
+                            Dict[str, List[AttrAccess]]] = {}
+        self.lock_pairs: List[LockPair] = []
+        self.blocking_sites: List[BlockingSite] = []
+        self.block_chain: Dict[int, List[str]] = {}
+        self._contexts: Dict[int, Dict[str, bool]] = {}
+        self._entry_locks: Dict[int, frozenset] = {}
+        self._edges: Dict[int, Set[int]] = {}
+        self._calls_held: List[Tuple[int, int, frozenset, int]] = []
+        self._direct_blocking: Dict[
+            int, List[Tuple[str, ast.Call, frozenset]]] = {}
+        self._acquires: Dict[int, Set[LockId]] = {}
+        self._method_by_name: Dict[str, Optional[FuncInfo]] = {}
+        self._discover_lock_types()
+        self._build_method_index()
+        self._walk_functions()
+        self._propagate_contexts()
+        self._propagate_entry_locks()
+        self._propagate_acquires()
+        self._propagate_blocking()
+        self._derive_interprocedural()
+
+    # -- type discovery -----------------------------------------------------
+    def _discover_lock_types(self) -> None:
+        """Lock/thread-safe attribute sets per class + module-level locks."""
+        for info in self.df.functions.values():
+            if info.cls is None:
+                continue
+            key = (info.relpath, info.cls)
+            for stmt in analysis.own_statements(info.node):
+                target = value = None
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    target, value = stmt.targets[0], stmt.value
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    target, value = stmt.target, stmt.value
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                        and isinstance(value, ast.Call)):
+                    continue
+                resolved = analysis.resolve(value.func, info.aliases)
+                if resolved in LOCK_FACTORIES:
+                    self.lock_attrs.setdefault(key, set()).add(target.attr)
+                if resolved in THREADSAFE_FACTORIES:
+                    self.safe_attrs.setdefault(key, set()).add(target.attr)
+        # module-level `_LOCK = threading.Lock()` assignments
+        for relpath, tree, aliases, _in_scope in self.df._iter_sources():
+            names: Set[str] = set()
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    if analysis.resolve(node.value.func,
+                                        aliases) in LOCK_FACTORIES:
+                        names.add(node.targets[0].id)
+            if names:
+                self.module_locks[relpath] = names
+
+    def _build_method_index(self) -> None:
+        """name → method, for names defined by exactly ONE project class
+        and not in the ubiquitous-name blocklist (CHA-by-unique-name)."""
+        for info in self.df.functions.values():
+            if info.cls is None or info.node.name in UNIQUE_METHOD_BLOCKLIST:
+                continue
+            name = info.node.name
+            if name in self._method_by_name:
+                self._method_by_name[name] = None    # ambiguous → unusable
+            else:
+                self._method_by_name[name] = info
+
+    def _resolve_call(self, call: ast.Call,
+                      info: FuncInfo) -> Optional[FuncInfo]:
+        """resolve_call, falling back to unique-method-name dispatch for
+        receiver-typed calls (``backend.penalize(...)``)."""
+        target = self.df.resolve_call(call, info)
+        if target is not None:
+            return target
+        func = call.func
+        if isinstance(func, ast.Attribute) and not (
+                isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls")):
+            head_is_import = (isinstance(func.value, ast.Name)
+                              and func.value.id in info.aliases)
+            if not head_is_import:
+                return self._method_by_name.get(func.attr)
+        return None
+
+    # -- lock expression resolution -----------------------------------------
+    def _lock_expr(self, expr: ast.AST,
+                   info: FuncInfo) -> Optional[LockId]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and info.cls:
+            if expr.attr in self.lock_attrs.get(
+                    (info.relpath, info.cls), ()):
+                return LockId(info.relpath, info.cls, expr.attr)
+        if isinstance(expr, ast.Name) and \
+                expr.id in self.module_locks.get(info.relpath, ()):
+            return LockId(info.relpath, None, expr.id)
+        return None
+
+    # -- per-function walk --------------------------------------------------
+    def _walk_functions(self) -> None:
+        for fid, info in self.df.functions.items():
+            self._edges[fid] = set()
+            self._acquires[fid] = set()
+            self._walk_body(info, info.node.body, (), in_loop=False)
+
+    def _walk_body(self, info: FuncInfo, stmts: List[ast.stmt],
+                   held: tuple, in_loop: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            self._visit_stmt(info, stmt, held, in_loop)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = list(held)
+                for item in stmt.items:
+                    lk = self._lock_expr(item.context_expr, info)
+                    if lk is None:
+                        continue
+                    for outer in inner:
+                        if outer != lk:
+                            self.lock_pairs.append(LockPair(
+                                outer, lk, info.relpath, stmt.lineno,
+                                info.in_scope))
+                    self._acquires[id(info.node)].add(lk)
+                    inner.append(lk)
+                self._walk_body(info, stmt.body, tuple(inner), in_loop)
+            elif isinstance(stmt, ast.If):
+                self._walk_body(info, stmt.body, held, in_loop)
+                self._walk_body(info, stmt.orelse, held, in_loop)
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                self._walk_body(info, stmt.body, held, True)
+                self._walk_body(info, stmt.orelse, held, in_loop)
+            elif isinstance(stmt, ast.Try):
+                self._walk_body(info, stmt.body, held, in_loop)
+                for h in stmt.handlers:
+                    self._walk_body(info, h.body, held, in_loop)
+                self._walk_body(info, stmt.orelse, held, in_loop)
+                self._walk_body(info, stmt.finalbody, held, in_loop)
+            elif isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    self._walk_body(info, case.body, held, in_loop)
+
+    def _self_attr_writes(self, stmt: ast.stmt) -> Dict[int, bool]:
+        """id(Attribute node) → rmw, for self-attrs this statement writes."""
+        out: Dict[int, bool] = {}
+        rmw = isinstance(stmt, ast.AugAssign)
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+
+        def visit(t, depth):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    visit(e, depth)
+            elif isinstance(t, ast.Starred):
+                visit(t.value, depth)
+            elif isinstance(t, ast.Subscript):
+                # self.X[k] = v mutates the object behind the attr
+                visit(t.value, depth + 1)
+            elif isinstance(t, ast.Attribute):
+                if isinstance(t.value, ast.Name) and t.value.id == "self":
+                    out[id(t)] = rmw or depth > 0
+                else:
+                    # self.a.b = v mutates the object behind self.a
+                    visit(t.value, depth + 1)
+
+        for t in targets:
+            visit(t, 0)
+        return out
+
+    def _visit_stmt(self, info: FuncInfo, stmt: ast.stmt,
+                    held: tuple, in_loop: bool) -> None:
+        fid = id(info.node)
+        held_f = frozenset(held)
+        write_nodes = self._self_attr_writes(stmt)
+        calls: List[ast.Call] = []
+        attrs: List[ast.Attribute] = []
+        for expr in analysis.statement_exprs(stmt):
+            for node in analysis.walk_exprs(expr):
+                if isinstance(node, ast.Call):
+                    calls.append(node)
+                elif isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self":
+                    attrs.append(node)
+        # container-mutator receivers count as writes: self.X.append(...)
+        for call in calls:
+            f = call.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in MUTATOR_METHODS and \
+                    isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id == "self":
+                write_nodes.setdefault(id(f.value), True)
+        if info.cls is not None:
+            owner = (info.relpath, info.cls)
+            for node in attrs:
+                w = write_nodes.get(id(node))
+                self.accesses.setdefault(owner, {}).setdefault(
+                    node.attr, []).append(AttrAccess(
+                        owner, node.attr,
+                        "write" if w is not None else "read",
+                        bool(w), info, stmt, node.lineno,
+                        node.col_offset, held_f))
+        for call in calls:
+            self._visit_call(info, fid, call, stmt, held_f, in_loop)
+
+    def _visit_call(self, info: FuncInfo, fid: int, call: ast.Call,
+                    stmt: ast.stmt, held: frozenset, in_loop: bool) -> None:
+        resolved = analysis.resolve(call.func, info.aliases)
+        if resolved in THREAD_FACTORIES:
+            self._record_spawn(info, call, stmt, in_loop)
+            return
+        desc = self._direct_blocking_desc(call, info, resolved)
+        if desc is not None:
+            self._direct_blocking.setdefault(fid, []).append(
+                (desc, call, held))
+        target = self._resolve_call(call, info)
+        if target is not None:
+            tid = id(target.node)
+            self._edges[fid].add(tid)
+            self._calls_held.append((fid, tid, held, call.lineno))
+
+    def _record_spawn(self, info: FuncInfo, call: ast.Call,
+                      stmt: ast.stmt, in_loop: bool) -> None:
+        target_expr = name = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target_expr = kw.value
+            elif kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                name = kw.value.value
+        target: Optional[FuncInfo] = None
+        if isinstance(target_expr, ast.Name):
+            target = self.df._local_defs.get(info.relpath, {}).get(
+                target_expr.id) or self.df._deref(
+                    info.aliases.get(target_expr.id))
+        elif isinstance(target_expr, ast.Attribute) and \
+                isinstance(target_expr.value, ast.Name) and \
+                target_expr.value.id in ("self", "cls") and info.cls:
+            target = self.df._methods.get(
+                (info.relpath, info.cls, target_expr.attr))
+        if target is None:
+            return
+        spawn = ThreadSpawn(
+            label=name or f"thread:{target.qualname}",
+            multi=in_loop, spawner=info, stmt=stmt, target=target,
+            lineno=call.lineno)
+        self.spawns.append(spawn)
+        self.spawns_by_func.setdefault(id(info.node), []).append(spawn)
+
+    def _direct_blocking_desc(self, call: ast.Call, info: FuncInfo,
+                              resolved: Optional[str]) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in BLOCKING_ATTR_CALLS:
+                return f"'.{func.attr}()'"
+            if func.attr in ("get", "join") and not call.args:
+                # zero-positional-arg get()/join(): queue/thread waits
+                # (dict.get and str.join always take a positional)
+                return f"'.{func.attr}()'"
+            if func.attr in READBACK_ATTRS and not call.args:
+                return f"device readback '.{func.attr}()'"
+        if resolved in BLOCKING_CALLS:
+            return f"'{resolved}'"
+        return None
+
+    # -- fixpoints ----------------------------------------------------------
+    def _propagate_contexts(self) -> None:
+        ctxs: Dict[int, Dict[str, bool]] = {
+            fid: {} for fid in self.df.functions}
+        called = {tid for callees in self._edges.values() for tid in callees}
+        spawned = {id(sp.target.node) for sp in self.spawns}
+        for fid in self.df.functions:
+            if fid not in called and fid not in spawned:
+                ctxs[fid][MAIN_CONTEXT] = False
+        for _ in range(60):   # bounded fixpoint
+            changed = False
+            for caller, callees in self._edges.items():
+                src = ctxs[caller]
+                if not src:
+                    continue
+                for callee in callees:
+                    dst = ctxs[callee]
+                    for label, multi in src.items():
+                        new = dst.get(label, False) or multi
+                        if dst.get(label) != new or label not in dst:
+                            dst[label] = new
+                            changed = True
+            for sp in self.spawns:
+                s_ctx = ctxs[id(sp.spawner.node)]
+                multi = sp.multi or len(s_ctx) > 1 or any(s_ctx.values())
+                dst = ctxs[id(sp.target.node)]
+                new = dst.get(sp.label, False) or multi
+                if dst.get(sp.label) != new or sp.label not in dst:
+                    dst[sp.label] = new
+                    changed = True
+            if not changed:
+                break
+        self._contexts = ctxs
+
+    def contexts_of(self, fid: int) -> Dict[str, bool]:
+        """label → multi for one function; unreached functions default to
+        the single main context."""
+        return self._contexts.get(fid) or {MAIN_CONTEXT: False}
+
+    def _propagate_entry_locks(self) -> None:
+        """Intersection (over all call paths) of locks held at entry."""
+        called = {tid for callees in self._edges.values() for tid in callees}
+        entry: Dict[int, Optional[frozenset]] = {}
+        for fid in self.df.functions:
+            if fid not in called:
+                entry[fid] = frozenset()
+        for _ in range(12):   # meets only shrink sets — converges fast
+            changed = False
+            for caller, callee, held, _ln in self._calls_held:
+                ce = entry.get(caller)
+                if ce is None:
+                    continue
+                at_site = ce | held
+                cur = entry.get(callee)
+                new = at_site if cur is None else (cur & at_site)
+                if new != cur:
+                    entry[callee] = new
+                    changed = True
+            if not changed:
+                break
+        self._entry_locks = {fid: s for fid, s in entry.items()
+                             if s is not None}
+
+    def entry_locks_of(self, fid: int) -> frozenset:
+        return self._entry_locks.get(fid, frozenset())
+
+    def locks_at(self, access: AttrAccess) -> frozenset:
+        """Locks guarding one access: lexical frames ∪ caller intersection."""
+        return access.lexical_locks | self.entry_locks_of(
+            id(access.func.node))
+
+    def _propagate_acquires(self) -> None:
+        for _ in range(30):
+            changed = False
+            for caller, callees in self._edges.items():
+                acc = self._acquires[caller]
+                before = len(acc)
+                for c in callees:
+                    acc |= self._acquires.get(c, set())
+                if len(acc) != before:
+                    changed = True
+            if not changed:
+                break
+
+    def _propagate_blocking(self) -> None:
+        for fid, sites in self._direct_blocking.items():
+            self.block_chain[fid] = [sites[0][0]]
+        changed = True
+        while changed:
+            changed = False
+            for fid, callees in self._edges.items():
+                if fid in self.block_chain:
+                    continue
+                for cid in callees:
+                    chain = self.block_chain.get(cid)
+                    if chain is None:
+                        continue
+                    new = [f"{self.df.functions[cid].node.name}()"] + chain
+                    if len(new) > 5:
+                        new = new[:2] + ["..."] + new[-1:]
+                    self.block_chain[fid] = new
+                    changed = True
+                    break
+
+    def _derive_interprocedural(self) -> None:
+        """Lock pairs and blocking sites through calls made under a lock."""
+        for caller, callee, held, lineno in self._calls_held:
+            if not held:
+                continue
+            info = self.df.functions[caller]
+            callee_info = self.df.functions[callee]
+            for inner in self._acquires.get(callee, ()):
+                for outer in held:
+                    if outer != inner:
+                        self.lock_pairs.append(LockPair(
+                            outer, inner, info.relpath, lineno,
+                            info.in_scope,
+                            via=f"{callee_info.node.name}()"))
+            chain = self.block_chain.get(callee)
+            if chain:
+                desc = " -> ".join(
+                    [f"{callee_info.node.name}()"] + chain[-2:])
+                for lk in held:
+                    self.blocking_sites.append(BlockingSite(
+                        lk, desc, info.relpath, lineno, 0, info.in_scope))
+        for fid, sites in self._direct_blocking.items():
+            info = self.df.functions[fid]
+            for desc, call, held in sites:
+                for lk in held:
+                    self.blocking_sites.append(BlockingSite(
+                        lk, desc, info.relpath, call.lineno,
+                        call.col_offset, info.in_scope))
+
+    # -- FX014 helpers ------------------------------------------------------
+    def is_init_access(self, access: AttrAccess) -> bool:
+        return access.func.node.name in INIT_METHODS
+
+    def happens_before_spawn(self, access: AttrAccess, label: str) -> bool:
+        """True when ``access`` is ordered before the spawn of ``label`` in
+        the same function (the init-before-spawn publish pattern: start()
+        binds the listener, then spawns the accept thread)."""
+        for sp in self.spawns_by_func.get(id(access.func.node), ()):
+            if sp.label != label:
+                continue
+            if sp.stmt is access.stmt:
+                return True   # `self._thread = threading.Thread(...)`
+            cfg = self.df.cfg(access.func)
+            a, s = id(access.stmt), id(sp.stmt)
+            if a not in cfg.stmts or s not in cfg.stmts:
+                continue
+            if s in cfg.reachable(a) and a not in cfg.reachable(s):
+                return True
+        return False
+
+    def conflict(self, w: AttrAccess,
+                 o: AttrAccess) -> Optional[Tuple[str, str]]:
+        """(ctx_of_w, ctx_of_o) when the write ``w`` and access ``o`` can
+        interleave from different threads with no common lock, else None."""
+        if self.locks_at(w) & self.locks_at(o):
+            return None
+        cw = self.contexts_of(id(w.func.node))
+        co = self.contexts_of(id(o.func.node))
+        for la, ma in cw.items():
+            for lb, _mb in co.items():
+                if la == lb and not ma:
+                    continue            # same single thread: ordered
+                if w is o and not w.rmw:
+                    continue            # one atomic store racing itself
+                if la == MAIN_CONTEXT and self.happens_before_spawn(w, lb):
+                    continue
+                if lb == MAIN_CONTEXT and self.happens_before_spawn(o, la):
+                    continue
+                return la, lb
+        return None
+
+
+def get_thread_model(project) -> ThreadModel:
+    """The project's thread/lock lattice, built once and cached (the three
+    FX014-FX016 rules share contexts, guarded-attr sets and summaries)."""
+    cached = getattr(project, "_lint_thread_model", None)
+    if cached is None:
+        cached = project._lint_thread_model = ThreadModel(
+            get_dataflow(project))
+    return cached

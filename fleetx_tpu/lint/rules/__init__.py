@@ -9,5 +9,6 @@ from fleetx_tpu.lint.rules import (  # noqa: F401
     pspec,
     retrace,
     sharding,
+    threads,
     tracing,
 )

@@ -1,0 +1,247 @@
+"""Thread-safety rules (FX014-FX016) over the thread/lock lattice.
+
+The serving fleet is the first genuinely concurrent subsystem in the tree:
+the engine loop, the replica accept/handler threads and the router's
+accept/poll/per-connection threads all share mutable state behind ad-hoc
+``threading.Lock`` discipline.  The bug class that takes such a fleet down
+is never a crash in review — it is a counter bumped off-lock from a
+per-connection handler, two locks taken in opposite orders on the drain
+path, or a socket ``recv`` sitting inside a ``with self._lock:`` so one
+stuck peer stalls every thread contending on the lock.  These rules make
+that class a lint failure, built on :class:`~fleetx_tpu.lint.dataflow.
+ThreadModel` (thread contexts from ``threading.Thread(target=...)`` sites,
+guarded-attribute sets from ``with self._lock`` discipline, both propagated
+over the interprocedural call graph):
+
+- **FX014** ``unguarded-shared-state`` — an attribute written on one thread
+  context and read/written on another with no common lock on some path.
+  FP guards: thread-safe containers (``queue.Queue``, ``deque``, ``Event``
+  &c.), ``__init__`` writes, thread-confined state (all accesses on one
+  single-instance context), writes ordered before the spawn in the same
+  function, and helpers only ever called under the lock (caller-entry lock
+  intersection).
+- **FX015** ``lock-order-inversion`` — lock A acquired under B on one
+  reachable path and B under A on another (lexically or through a call
+  made under a lock), the classic ABBA deadlock.
+- **FX016** ``blocking-call-under-lock`` — socket recv/accept, zero-arg
+  ``.get()``/``.join()``, subprocess waits, ``time.sleep`` or a jax device
+  sync reachable while a lock is held: the drain-stall shape.
+
+All three are *may* analyses (see docs/static_analysis.md "Scope and
+limits"); deliberate lock-free protocols are silenced inline with
+``# fleetx: noqa[rule] -- reason``, never baselined.  The runtime half of
+the contract is ``fleetx_tpu/observability/tsan.py`` (``FLEETX_TSAN=1``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Tuple
+
+from fleetx_tpu.lint import dataflow
+from fleetx_tpu.lint.core import (Finding, Project, Rule,
+                                  iter_context_files, register)
+
+
+def callgraph_fingerprint(project: Project) -> str:
+    """Content fingerprint of the thread rules' input surface: the scanned
+    modules plus every ``CONSUMER_DIRS`` python file (the call graph the
+    lattice propagates over) — and nothing else.  Unlike
+    :meth:`Project.digest` this excludes the YAML config zoo, so config-only
+    edits keep the thread-rule cache warm while ANY cross-file python edit
+    (a new spawn site, a helper moved under a lock) invalidates it.
+    """
+    cached = getattr(project, "_lint_callgraph_fp", None)
+    if cached is not None:
+        return cached
+    h = hashlib.sha1()
+    seen: set = set()
+    for m in project.modules:
+        seen.add(m.relpath)
+        h.update(f"{m.relpath}\0{m.sha1}\0".encode("utf-8"))
+    for f in iter_context_files(project.root):
+        rel = project.relpath(f)
+        if rel in seen:
+            continue
+        seen.add(rel)
+        try:
+            payload = f.read_bytes()
+        except OSError:
+            continue
+        h.update(f"{rel}\0".encode("utf-8"))
+        h.update(hashlib.sha1(payload).digest())
+    project._lint_callgraph_fp = h.hexdigest()
+    return project._lint_callgraph_fp
+
+
+class _ThreadRule(Rule):
+    """Shared plumbing: project scope, lattice access, call-graph cache key."""
+
+    scope = "project"
+    category = "threads"
+
+    def project_digest(self, project: Project) -> str:
+        return callgraph_fingerprint(project)
+
+
+def _ctx_text(tm: dataflow.ThreadModel, fid: int) -> str:
+    parts = []
+    for label, multi in sorted(tm.contexts_of(fid).items()):
+        parts.append(f"'{label}' (xN)" if multi else f"'{label}'")
+    return "/".join(parts)
+
+
+@register
+class UnguardedSharedState(_ThreadRule):
+    """Cross-thread attribute traffic with no common lock."""
+
+    name = "unguarded-shared-state"
+    code = "FX014"
+    description = ("attribute written on one thread context and read/"
+                   "written on another with no common lock held — guard "
+                   "both sides with one lock or make the state "
+                   "thread-confined")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        tm = dataflow.get_thread_model(project)
+        out: List[Finding] = []
+        for owner, attrs in sorted(tm.accesses.items()):
+            relpath, cls = owner
+            safe = tm.safe_attrs.get(owner, set()) | \
+                tm.lock_attrs.get(owner, set())
+            for attr, accesses in sorted(attrs.items()):
+                if attr in safe:
+                    continue
+                hit = self._conflict_for(tm, accesses)
+                if hit is None:
+                    continue
+                write, other, ctx_w, ctx_o = hit
+                anchor = self._anchor(tm, accesses, write)
+                if not anchor.func.in_scope:
+                    continue
+                counterpart = other if anchor is write else write
+                where = (f"line {counterpart.lineno}"
+                         if counterpart.func.relpath == anchor.func.relpath
+                         else f"{counterpart.func.relpath}:"
+                              f"{counterpart.lineno}")
+                out.append(self.finding(
+                    anchor.func.relpath, anchor.lineno, anchor.col,
+                    f"'{cls}.{attr}' is written on thread context "
+                    f"{_ctx_text(tm, id(write.func.node))} "
+                    f"({write.func.node.name}, line {write.lineno}) and "
+                    f"{'written' if other.kind == 'write' else 'read'} on "
+                    f"{_ctx_text(tm, id(other.func.node))} "
+                    f"({other.func.node.name}, {where}) with no common "
+                    f"lock held — interleavings lose updates or observe "
+                    f"torn state; guard both sides with one lock (e.g. "
+                    f"'with self._lock:') or make the attribute "
+                    f"thread-confined"))
+        return out
+
+    @staticmethod
+    def _conflict_for(tm, accesses):
+        """First (write, other-access) pair that can interleave cross-thread
+        unlocked — one finding per (class, attr) keeps triage tractable."""
+        writes = [a for a in accesses
+                  if a.kind == "write" and not tm.is_init_access(a)]
+        for w in writes:
+            for o in accesses:
+                if tm.is_init_access(o):
+                    continue
+                hit = tm.conflict(w, o)
+                if hit is not None:
+                    return w, o, hit[0], hit[1]
+        return None
+
+    @staticmethod
+    def _anchor(tm, accesses, write):
+        """Prefer anchoring on an in-scope unlocked write (the fix site)."""
+        if write.func.in_scope:
+            return write
+        for a in accesses:
+            if a.func.in_scope and a.kind == "write" and \
+                    not tm.locks_at(a) and not tm.is_init_access(a):
+                return a
+        for a in accesses:
+            if a.func.in_scope and not tm.is_init_access(a):
+                return a
+        return write
+
+
+@register
+class LockOrderInversion(_ThreadRule):
+    """Two locks acquired in opposite orders on reachable paths."""
+
+    name = "lock-order-inversion"
+    code = "FX015"
+    description = ("locks acquired in opposite orders on two reachable "
+                   "paths (lexically or through calls made under a lock) "
+                   "— ABBA deadlock under contention; pick one global "
+                   "acquisition order")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        tm = dataflow.get_thread_model(project)
+        by_pair: Dict[Tuple[dataflow.LockId, dataflow.LockId],
+                      List[dataflow.LockPair]] = {}
+        for p in tm.lock_pairs:
+            by_pair.setdefault((p.first, p.second), []).append(p)
+        out: List[Finding] = []
+        seen: set = set()
+        for (a, b), sites in sorted(
+                by_pair.items(), key=lambda kv: (kv[0][0].label,
+                                                 kv[0][1].label)):
+            rev = by_pair.get((b, a))
+            if not rev:
+                continue
+            for site in sites:
+                if not site.in_scope:
+                    continue
+                key = (a, b, site.relpath, site.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                opp = rev[0]
+                via = f" (via {site.via})" if site.via else ""
+                opp_via = f" via {opp.via}" if opp.via else ""
+                out.append(self.finding(
+                    site.relpath, site.lineno, 0,
+                    f"lock '{b.label}' acquired while '{a.label}' is "
+                    f"held{via}, but the opposite order is taken at "
+                    f"{opp.relpath}:{opp.lineno}{opp_via} — two threads "
+                    f"taking the orders concurrently deadlock; pick one "
+                    f"global acquisition order and restructure the later "
+                    f"site"))
+                break  # one finding per ordered pair
+        return out
+
+
+@register
+class BlockingCallUnderLock(_ThreadRule):
+    """(May-)blocking calls reachable while a lock is held."""
+
+    name = "blocking-call-under-lock"
+    code = "FX016"
+    description = ("socket recv/accept, queue get/join, subprocess wait, "
+                   "sleep or device sync reachable while a lock is held — "
+                   "every thread contending on the lock stalls behind the "
+                   "call (the drain-stall shape); move it outside the lock")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        tm = dataflow.get_thread_model(project)
+        out: List[Finding] = []
+        seen: set = set()
+        for site in tm.blocking_sites:
+            if not site.in_scope:
+                continue
+            key = (site.relpath, site.lineno, site.lock)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(self.finding(
+                site.relpath, site.lineno, site.col,
+                f"{site.desc} can block while lock '{site.lock.label}' is "
+                f"held — every thread contending on '{site.lock.label}' "
+                f"stalls behind this call until it returns (drain-stall "
+                f"shape); move the blocking call outside the lock, or use "
+                f"a non-blocking variant with a timeout"))
+        return out

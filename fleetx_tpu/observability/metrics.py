@@ -39,7 +39,7 @@ class Counter:
         self._value = 0.0
 
     def inc(self, amount: float = 1.0) -> None:
-        self._value += amount
+        self._value += amount  # fleetx: noqa[FX014] -- documented lock-free design (module docstring): a float += under the GIL may at worst lose a tick; metrics tolerate that, a per-inc lock on the train-loop hot path does not
 
     @property
     def value(self) -> float:
@@ -178,29 +178,34 @@ class MetricsRegistry:
         """Flat, JSON-ready view: counters/gauges as scalars, histograms as
         their summary dicts."""
         out: dict[str, Any] = {}
-        for c in self._counters.values():
-            out[c.name] = c.value
-        for g in self._gauges.values():
-            out[g.name] = g.value
-        for h in self._histograms.values():
-            out[h.name] = h.summary()
+        # the lock covers the dict iteration: counter()/histogram() insert
+        # from the watchdog thread, and a resize mid-iteration raises
+        with self._lock:
+            for c in self._counters.values():
+                out[c.name] = c.value
+            for g in self._gauges.values():
+                out[g.name] = g.value
+            for h in self._histograms.values():
+                out[h.name] = h.summary()
         return out
 
     def reset_window(self) -> None:
         """Clear histogram windows (counters and gauges persist)."""
-        for h in self._histograms.values():
-            h.reset()
+        with self._lock:
+            for h in self._histograms.values():
+                h.reset()
 
     def reset(self) -> None:
         """Full reset — counters, gauges and histogram windows."""
-        for c in self._counters.values():
-            c.reset()
-        for g in self._gauges.values():
-            g.reset()
-        for h in self._histograms.values():
-            h.reset()
-            h.total_count = 0
-            h.total_sum = 0.0
+        with self._lock:
+            for c in self._counters.values():
+                c.reset()
+            for g in self._gauges.values():
+                g.reset()
+            for h in self._histograms.values():
+                h.reset()
+                h.total_count = 0
+                h.total_sum = 0.0
 
 
 class _Timer:

@@ -128,9 +128,9 @@ class StepWatchdog:
         now = time.monotonic()
         if self._last_beat is not None:
             self._beats.append(now - self._last_beat)
-        self._last_beat = now
-        self._last_step = step
-        self._fired_for = None  # re-arm after any progress
+        self._last_beat = now  # fleetx: noqa[FX014] -- deliberate lock-free protocol: monitor-thread reads tolerate one stale beat (next poll sees it); a beat()-side lock would put lock traffic on every train step
+        self._last_step = step  # fleetx: noqa[FX014] -- same lock-free beat protocol: _run only formats _last_step into the stall report, staleness is cosmetic
+        self._fired_for = None  # re-arm after any progress  # fleetx: noqa[FX014] -- same lock-free beat protocol: worst case is one duplicate or suppressed stall report, never a missed wedge (the beat gap keeps growing)
 
     @contextlib.contextmanager
     def suspended(self):
@@ -139,7 +139,7 @@ class StepWatchdog:
         post-phase beat can't retroactively excuse — the detector would
         already have fired (and under ``action: abort``, killed the run)
         mid-phase. The clock restarts when the phase ends."""
-        self._suspended += 1
+        self._suspended += 1  # fleetx: noqa[FX014] -- suspended() only runs on the train-loop thread (re-entrant phases nest, hence a counter not a flag); the monitor thread only reads, and a stale read just delays the disarm by one poll
         try:
             yield self
         finally:

@@ -42,7 +42,7 @@ import numpy as np
 # sibling replica's regardless of which modules loaded first
 # (parallel/mesh.py documents the layout-variance this prevents)
 import fleetx_tpu.parallel.mesh  # noqa: F401  (imported for its config pin)
-from fleetx_tpu.observability import flight
+from fleetx_tpu.observability import flight, tsan
 from fleetx_tpu.observability.flight import EventRing
 from fleetx_tpu.observability.metrics import get_registry
 from fleetx_tpu.observability.slo import SLORegistry
@@ -221,7 +221,7 @@ class TimelineStore:
                  events_per_request: int = 128):
         self.max_requests = max(int(max_requests), 1)
         self.events_per_request = max(int(events_per_request), 8)
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("serving.timelines")
         self._timelines: "OrderedDict[str, RequestTimeline]" = OrderedDict()
 
     def open(self, rid: str) -> RequestTimeline:
@@ -315,6 +315,10 @@ class ServingEngine:
         # chips this replica occupies: its mesh size, or one device for an
         # unsharded replica — the denominator of requests-per-chip
         self.n_chips = int(mesh.size) if mesh is not None else 1
+        # scheduler state is engine-thread-confined by design: handler
+        # threads must go through the server's submission queue, never
+        # call submit()/step() directly. FLEETX_TSAN=1 enforces that.
+        tsan.register_object(self, "serving-engine")
         logger.info(
             "serving engine: max_batch=%d pages=%d x %d tokens "
             "(capacity %d token slots/layer), prefill_chunk=%d, "
@@ -328,6 +332,7 @@ class ServingEngine:
                callback: Optional[Callable] = None) -> ServingRequest:
         """Queue one request; refusals (drain / permanent OOM) come back
         with ``state == REFUSED`` and ``error`` set, never queued."""
+        tsan.note_access(self, "submit")
         rid = request_id if request_id is not None \
             else f"req{self._rid_counter}"
         self._rid_counter += 1
@@ -490,6 +495,7 @@ class ServingEngine:
     # ------------------------------------------------------------------ loop
     def step(self) -> bool:
         """One scheduler iteration; True when any device work ran."""
+        tsan.note_access(self, "step")
         self._admit()
         worked = self._prefill_step()
         worked = self._decode_step() or worked

@@ -42,6 +42,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, Optional
 
+from fleetx_tpu.observability import tsan
+
 #: seconds a failed/draining backend is skipped before being retried
 #: (a supervisor restart needs a few seconds to bring the replica back)
 PENALTY_S = 1.0
@@ -118,7 +120,7 @@ class RequestJournal:
                  events_per_request: int = 64):
         self.max_requests = max(int(max_requests), 1)
         self.events_per_request = max(int(events_per_request), 8)
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("router.journal")
         self._events: "OrderedDict[str, deque]" = OrderedDict()
 
     def note(self, rid, name: str, **data) -> None:
@@ -232,7 +234,7 @@ class Router:
         self.fleet_out = fleet_out
         self.poll_interval = float(poll_interval)
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("router.placement")
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
         self.retries = 0
@@ -271,6 +273,14 @@ class Router:
         with self._lock:
             backend.outstanding = max(backend.outstanding - 1, 0)
 
+    def _note_failure(self, backend: Backend) -> None:
+        """Penalise a backend and count the retry under the placement lock
+        — ``pick()`` reads the penalty window under the same lock, and the
+        retry counter is bumped from every per-connection handler."""
+        with self._lock:
+            backend.penalize(time.monotonic())
+            self.retries += 1
+
     # ------------------------------------------------------------- dispatch
     def dispatch(self, payload: dict) -> dict:
         """Forward one request, re-dispatching across backends until a
@@ -296,8 +306,7 @@ class Router:
                 # transport failure OR a torn/garbled response line (a
                 # replica killed mid-write) — both mean "this backend did
                 # not complete the request": penalise and re-dispatch
-                backend.penalize(time.monotonic())
-                self.retries += 1
+                self._note_failure(backend)
                 self._count("penalties_total")
                 self.journal.note(rid, "transport_retry", backend=addr)
                 continue
@@ -306,8 +315,7 @@ class Router:
             if resp.get("error") == "draining":
                 # graceful reclaim: stop placing onto this backend and
                 # retry the request elsewhere, losing nothing
-                backend.penalize(time.monotonic())
-                self.retries += 1
+                self._note_failure(backend)
                 self._count("penalties_total")
                 self._count("drain_refusals_total")
                 self.journal.note(rid, "drain_refusal", backend=addr)
@@ -400,8 +408,13 @@ class Router:
                 print(f"[router] dropping invalid fleet record: "
                       f"{problems}", flush=True)
                 continue
-            if self._fleet_sink is not None:
-                self._fleet_sink.emit(record)
+            with self._lock:  # close() swaps the sink out under the lock
+                sink = self._fleet_sink
+            if sink is not None:
+                try:
+                    sink.emit(record)
+                except (OSError, ValueError):
+                    pass  # sink closed mid-shutdown — record is dropped
 
     # -------------------------------------------------------------- serving
     def start(self) -> int:
@@ -464,12 +477,13 @@ class Router:
                 self._listener.close()
             except OSError:
                 pass
-        if self._fleet_sink is not None:
+        with self._lock:  # the poll loop reads the sink under the lock
+            sink, self._fleet_sink = self._fleet_sink, None
+        if sink is not None:
             try:
-                self._fleet_sink.close()
+                sink.close()
             except OSError:
                 pass
-            self._fleet_sink = None
 
 
 def main(argv=None) -> int:

@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Optional
 
-from fleetx_tpu.observability import flight
+from fleetx_tpu.observability import flight, tsan
 from fleetx_tpu.utils.log import logger
 
 #: per-request completion wait bound (covers queue time under load)
@@ -214,6 +214,10 @@ class ReplicaServer:
         """The scheduler loop; returns once a latched preemption has fully
         drained. ``preemption``: a ``PreemptionHandler`` (or anything with
         ``.triggered``) polled at every step boundary."""
+        # this loop's thread owns the engine from here on: handler threads
+        # must reach engine state only via the submission/control queues,
+        # and FLEETX_TSAN=1 flags any direct touch
+        tsan.register_object(self.engine, "serving-engine")
         work_steps = 0
         while True:
             if preemption is not None and preemption.triggered and \

@@ -1,0 +1,18 @@
+"""FX014 negative: state touched only on its own (single) thread."""
+import threading
+
+
+class Loop:
+    """All mutable state confined to the loop thread."""
+
+    def __init__(self):
+        self._steps = 0
+
+    def start(self):
+        """Spawn the loop thread."""
+        threading.Thread(target=self._run, name="loop").start()
+
+    def _run(self):
+        """Loop thread: sole reader AND writer of ``_steps``."""
+        while self._steps < 3:
+            self._steps += 1

@@ -1,0 +1,21 @@
+"""FX014 negative: an Event flags completion across threads."""
+import threading
+
+
+class Job:
+    """Worker signals completion via a ``threading.Event``."""
+
+    def __init__(self):
+        self._done = threading.Event()
+
+    def start(self):
+        """Spawn the worker."""
+        threading.Thread(target=self._work, name="job-worker").start()
+
+    def _work(self):
+        """Worker thread side."""
+        self._done.set()
+
+    def finished(self):
+        """Main thread side."""
+        return self._done.is_set()

@@ -1,0 +1,18 @@
+"""FX014 negative: publish-before-spawn writes are ordered by the spawn."""
+import threading
+
+
+class Server:
+    """``start`` binds state, then spawns the thread that reads it."""
+
+    def __init__(self):
+        self.sock = None
+
+    def start(self):
+        """Bind, then spawn: the write happens-before the thread exists."""
+        self.sock = object()
+        threading.Thread(target=self._accept, name="acceptor").start()
+
+    def _accept(self):
+        """Acceptor thread reads the pre-spawn binding."""
+        return self.sock

@@ -1,0 +1,22 @@
+"""FX014 negative: a thread-safe queue carries the cross-thread traffic."""
+import queue
+import threading
+
+
+class Pipeline:
+    """Producer thread feeds a queue the main thread drains."""
+
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def start(self):
+        """Spawn the producer."""
+        threading.Thread(target=self._produce, name="producer").start()
+
+    def _produce(self):
+        """Producer thread side."""
+        self._q.put(1)
+
+    def drain(self):
+        """Main thread side."""
+        return self._q.get_nowait()

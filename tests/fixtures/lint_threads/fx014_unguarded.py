@@ -1,0 +1,23 @@
+"""FX014 positive: a worker thread mutates state the main thread reads."""
+import threading
+
+
+class Stats:
+    """Shared stats with no lock discipline — the true-positive shape."""
+
+    def __init__(self):
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        """Spawn the worker."""
+        self._thread = threading.Thread(target=self._worker, name="worker")
+        self._thread.start()
+
+    def _worker(self):
+        """Runs on the worker thread."""
+        self.count += 1
+
+    def total(self):
+        """Read from the main thread while the worker is live."""
+        return self.count

@@ -1,0 +1,23 @@
+"""FX015 positive: two locks taken in opposite orders (ABBA deadlock)."""
+import threading
+
+
+class Ledger:
+    """``transfer`` takes a->b while ``audit`` takes b->a."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.total = 0
+
+    def transfer(self):
+        """Acquires a then b."""
+        with self._a:
+            with self._b:
+                self.total += 1
+
+    def audit(self):
+        """Acquires b then a — deadlocks against ``transfer``."""
+        with self._b:
+            with self._a:
+                return self.total

@@ -1,0 +1,23 @@
+"""FX015 negative: both paths honour one global acquisition order."""
+import threading
+
+
+class Ledger:
+    """Every path takes a before b."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.total = 0
+
+    def transfer(self):
+        """Acquires a then b."""
+        with self._a:
+            with self._b:
+                self.total += 1
+
+    def audit(self):
+        """Same order: a then b."""
+        with self._a:
+            with self._b:
+                return self.total

@@ -1,0 +1,16 @@
+"""FX016 positive: a socket receive inside the lock (drain-stall shape)."""
+import threading
+
+
+class Poller:
+    """Holds the lock across a blocking receive."""
+
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self.last = b""
+
+    def poll(self):
+        """Every thread contending on the lock stalls behind the recv."""
+        with self._lock:
+            self.last = self._sock.recv(4096)

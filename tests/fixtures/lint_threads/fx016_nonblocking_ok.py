@@ -1,0 +1,17 @@
+"""FX016 negative: the blocking call sits outside the lock."""
+import threading
+
+
+class Poller:
+    """Receives outside the lock; the lock covers only the publish."""
+
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self.last = b""
+
+    def poll(self):
+        """Receive unlocked, publish under the lock."""
+        data = self._sock.recv(4096)
+        with self._lock:
+            self.last = data

@@ -742,7 +742,13 @@ def test_fleet_observer_drain_traces_and_slo_gate(tmp_path):
     must surface the drain refusal + re-dispatch in its merged trace
     (and still complete), coverage must drop to 1 without breaking the
     record stream, and ``tools/slo_report.py`` must pass the met SLO and
-    fail a synthetic breach on the same file."""
+    fail a synthetic breach on the same file.
+
+    Both replicas AND the router run under ``FLEETX_TSAN=1``: the runtime
+    lock sanitizer wraps the real serving locks (router placement/journal,
+    engine timelines), asserts one global acquisition order and flags
+    cross-thread engine access — a lock-order inversion anywhere on the
+    drill's dispatch/drain/poll paths turns into a hard failure here."""
     cfg_path = _serve_yaml(tmp_path)
     readys = [tmp_path / f"ready{i}.json" for i in range(2)]
     fleet_path = tmp_path / "fleet.jsonl"
@@ -752,7 +758,8 @@ def test_fleet_observer_drain_traces_and_slo_gate(tmp_path):
             [sys.executable, SERVE, "-c", cfg_path,
              "--ready-file", str(readys[i]), "--preemption-code", "75"],
             env=_subprocess_env(
-                FLEETX_FLIGHT_DIR=str(tmp_path / f"flight{i}")),
+                FLEETX_FLIGHT_DIR=str(tmp_path / f"flight{i}"),
+                FLEETX_TSAN="1"),
             stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
     router = None
     try:
@@ -762,6 +769,7 @@ def test_fleet_observer_drain_traces_and_slo_gate(tmp_path):
              "--backends",
              f"127.0.0.1:{infos[0]['port']},127.0.0.1:{infos[1]['port']}",
              "--fleet-out", str(fleet_path), "--poll-interval", "0.25"],
+            env=_subprocess_env(FLEETX_TSAN="1"),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         line = router.stdout.readline()
         assert "listening on" in line, line

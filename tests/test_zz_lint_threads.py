@@ -1,0 +1,575 @@
+"""Thread-safety race detector coverage: the FX014-FX016 lattice rules
+(``lint/rules/threads.py`` over ``lint/dataflow.py``'s ThreadModel) and the
+runtime lock sanitizer (``observability/tsan.py``), per
+docs/static_analysis.md "v3 — thread-safety".
+
+Every rule gets at least one true-positive fixture and one false-positive
+guard (``tests/fixtures/lint_threads/``): lock-free queues, ``Event``,
+thread-confined state and init-before-spawn writes must all pass clean.
+The serving-fleet bug shapes fixed in this PR are regression fixtures:
+
+- the off-lock ``backend.penalize`` + retry counter bump from a
+  per-connection handler (FX014, interprocedural through a receiver-typed
+  call) — with the shipped fix shape (a helper only ever called under the
+  lock) passing via the caller-entry lock intersection;
+- the blocking ``queue.get()`` reachable under a lock through a helper
+  call (FX016, interprocedural);
+- the ABBA lock-order inversion (FX015).
+
+Plus the machinery: zero findings over the repo's own ``fleetx_tpu/``
+tree, the call-graph cache fingerprint (python edits invalidate, YAML
+edits stay warm), the ``--rules`` CLI flag, SARIF inclusion, and the
+SanLock order/ownership assertions.
+"""
+
+import importlib.util
+import os
+import textwrap
+import threading
+
+import pytest
+
+from fleetx_tpu.lint import render_sarif, run_lint
+from fleetx_tpu.observability import tsan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint_threads")
+
+pytestmark = [pytest.mark.lint, pytest.mark.lint_threads]
+
+THREAD_RULES = ["threads"]   # the category selects FX014/FX015/FX016
+
+
+def _project(tmp_path, **files):
+    """Write dedented sources into tmp_path and run the thread rules."""
+    paths = []
+    for name, src in files.items():
+        p = tmp_path / f"{name}.py"
+        p.write_text(textwrap.dedent(src))
+        paths.append(p)
+    return run_lint(paths, root=tmp_path, select=THREAD_RULES)
+
+
+def _rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+# ======================================================= fixture files
+
+@pytest.mark.parametrize("fixture,expected", [
+    ("fx014_unguarded.py", "unguarded-shared-state"),
+    ("fx015_inversion.py", "lock-order-inversion"),
+    ("fx016_blocking.py", "blocking-call-under-lock"),
+])
+def test_positive_fixture(fixture, expected):
+    res = run_lint([_fixture(fixture)], root=FIXTURES, select=THREAD_RULES)
+    assert expected in _rules_of(res), \
+        f"{fixture} must trip {expected}: {res.findings}"
+
+
+@pytest.mark.parametrize("fixture", [
+    "fx014_queue_ok.py",        # queue.Queue synchronizes internally
+    "fx014_event_ok.py",        # threading.Event ditto
+    "fx014_confined_ok.py",     # single-thread-confined state
+    "fx014_init_before_spawn_ok.py",  # write ordered before the spawn
+    "fx015_ordered_ok.py",      # one global lock order
+    "fx016_nonblocking_ok.py",  # the blocking call sits outside the lock
+])
+def test_negative_fixture(fixture):
+    res = run_lint([_fixture(fixture)], root=FIXTURES, select=THREAD_RULES)
+    assert res.findings == [], f"{fixture} must pass clean: {res.findings}"
+
+
+def test_fx014_message_names_both_sites():
+    res = run_lint([_fixture("fx014_unguarded.py")], root=FIXTURES,
+                   select=THREAD_RULES)
+    msg = res.findings[0].message
+    assert "Stats.count" in msg and "worker" in msg and "main" in msg
+    assert "with self._lock:" in msg   # the remedy is in the message
+
+
+def test_fx015_message_names_the_opposite_site():
+    res = run_lint([_fixture("fx015_inversion.py")], root=FIXTURES,
+                   select=THREAD_RULES)
+    inv = [f for f in res.findings if f.rule == "lock-order-inversion"]
+    assert inv and "opposite order" in inv[0].message
+    assert "deadlock" in inv[0].message
+
+
+# ================================================= interprocedural shapes
+
+def test_fx014_interprocedural_receiver_typed_call(tmp_path):
+    """The serving-router bug shape: a per-connection handler penalises a
+    backend off-lock while placement reads the penalty window under the
+    lock.  The write is two hops away through a receiver-typed call only
+    the unique-method-name fallback can resolve."""
+    res = _project(tmp_path, m='''
+        """Doc."""
+        import threading
+
+
+        class Backend:
+            """Doc."""
+
+            def __init__(self):
+                self.penalized = 0.0
+
+            def penalize(self, now):
+                """Doc."""
+                self.penalized = now
+
+            def usable(self, now):
+                """Doc."""
+                return now >= self.penalized
+
+
+        class Router:
+            """Doc."""
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.backends = []
+
+            def pick(self, now):
+                """Doc."""
+                with self._lock:
+                    return [b for b in self.backends if b.usable(now)]
+
+            def serve(self):
+                """Doc."""
+                while True:
+                    threading.Thread(target=self._handle).start()
+
+            def _handle(self):
+                """Doc."""
+                got = self.pick(0.0)
+                if got:
+                    got[0].penalize(1.0)
+    ''')
+    assert "unguarded-shared-state" in _rules_of(res)
+    assert any("Backend.penalized" in f.message for f in res.findings)
+
+
+def test_fx014_locked_helper_negative(tmp_path):
+    """The shipped fix shape: the helper is only ever called under the
+    lock, so the caller-entry lock intersection guards its write."""
+    res = _project(tmp_path, m='''
+        """Doc."""
+        import threading
+
+
+        class Backend:
+            """Doc."""
+
+            def __init__(self):
+                self.penalized = 0.0
+
+            def penalize(self, now):
+                """Doc."""
+                self.penalized = now
+
+            def usable(self, now):
+                """Doc."""
+                return now >= self.penalized
+
+
+        class Router:
+            """Doc."""
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.backends = []
+
+            def pick(self, now):
+                """Doc."""
+                with self._lock:
+                    return [b for b in self.backends if b.usable(now)]
+
+            def serve(self):
+                """Doc."""
+                while True:
+                    threading.Thread(target=self._handle).start()
+
+            def _note_failure(self, backend):
+                """Doc."""
+                with self._lock:
+                    backend.penalize(1.0)
+
+            def _handle(self):
+                """Doc."""
+                got = self.pick(0.0)
+                if got:
+                    self._note_failure(got[0])
+    ''')
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_fx014_single_site_rmw_races_itself(tmp_path):
+    """A += on a multi-instance context conflicts with ITSELF — two
+    handler threads interleave the read-modify-write."""
+    res = _project(tmp_path, m='''
+        """Doc."""
+        import threading
+
+
+        class Counter:
+            """Doc."""
+
+            def __init__(self):
+                self.hits = 0
+
+            def serve(self):
+                """Doc."""
+                while True:
+                    threading.Thread(target=self._handle).start()
+
+            def _handle(self):
+                """Doc."""
+                self.hits += 1
+    ''')
+    assert _rules_of(res) == ["unguarded-shared-state"]
+    assert "Counter.hits" in res.findings[0].message
+
+
+def test_fx016_interprocedural_blocking_helper(tmp_path):
+    """The blocking queue.get() is one call away from the lock."""
+    res = _project(tmp_path, m='''
+        """Doc."""
+        import queue
+        import threading
+
+
+        class Store:
+            """Doc."""
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def _pull(self):
+                """Doc."""
+                return self._q.get()
+
+            def flush(self):
+                """Doc."""
+                with self._lock:
+                    return self._pull()
+    ''')
+    assert "blocking-call-under-lock" in _rules_of(res)
+    hit = [f for f in res.findings
+           if f.rule == "blocking-call-under-lock"][0]
+    assert "_pull()" in hit.message and "Store._lock" in hit.message
+
+
+def test_fx016_get_nowait_negative(tmp_path):
+    """Non-blocking drain under the lock is the sanctioned shape."""
+    res = _project(tmp_path, m='''
+        """Doc."""
+        import queue
+        import threading
+
+
+        class Store:
+            """Doc."""
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def flush(self):
+                """Doc."""
+                with self._lock:
+                    return self._q.get_nowait()
+    ''')
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_fx015_interprocedural_inversion(tmp_path):
+    """The second lock is acquired inside a helper called under the
+    first — only the transitive acquisition summary can see the cycle."""
+    res = _project(tmp_path, m='''
+        """Doc."""
+        import threading
+
+
+        class Ledger:
+            """Doc."""
+
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _inner_b(self):
+                """Doc."""
+                with self._b:
+                    return 1
+
+            def forward(self):
+                """Doc."""
+                with self._a:
+                    return self._inner_b()
+
+            def backward(self):
+                """Doc."""
+                with self._b:
+                    with self._a:
+                        return 2
+    ''')
+    assert "lock-order-inversion" in _rules_of(res)
+
+
+def test_fx014_noqa_suppression(tmp_path):
+    res = _project(tmp_path, m='''
+        """Doc."""
+        import threading
+
+
+        class Stats:
+            """Doc."""
+
+            def __init__(self):
+                self.count = 0
+
+            def start(self):
+                """Doc."""
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                """Doc."""
+                self.count += 1  # fleetx: noqa[FX014] -- benign monotonic hint, staleness tolerated
+
+            def total(self):
+                """Doc."""
+                return self.count
+    ''')
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+# ============================================== repo gate: zero baseline
+
+def test_repo_thread_rules_zero_findings():
+    """The serving fleet (and the whole tree) is clean under FX014-FX016
+    with zero baseline entries — every real finding was fixed or
+    justified inline, same policy as FX001-FX013."""
+    res = run_lint([os.path.join(REPO, "fleetx_tpu")], root=REPO,
+                   select=THREAD_RULES)
+    assert res.findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in res.findings)
+    # the deliberate lock-free designs are suppressed INLINE with reasons,
+    # never baselined (watchdog beat protocol, metrics counters, BPE memo
+    # cache, native build serialisation)
+    assert len(res.suppressed) >= 6
+
+
+def test_repo_serving_locks_are_sanitized():
+    """The serving locks go through tsan.lock so FLEETX_TSAN=1 instruments
+    the real fleet in the 2-replica drill."""
+    for rel, name in (("fleetx_tpu/serving/router.py", "router.placement"),
+                      ("fleetx_tpu/serving/router.py", "router.journal"),
+                      ("fleetx_tpu/serving/engine.py",
+                       "serving.timelines")):
+        with open(os.path.join(REPO, rel)) as f:
+            assert f'tsan.lock("{name}")' in f.read(), (rel, name)
+
+
+# ======================================================== registry/scope
+
+def test_thread_rules_registered_project_scope():
+    from fleetx_tpu.lint import all_rules
+
+    rules = all_rules()
+    for name, code in (("unguarded-shared-state", "FX014"),
+                       ("lock-order-inversion", "FX015"),
+                       ("blocking-call-under-lock", "FX016")):
+        assert name in rules and rules[name].code == code, name
+        assert rules[name].scope == "project"
+        assert rules[name].category == "threads"
+    codes = [r.code for r in rules.values()]
+    assert len(codes) == len(set(codes))
+
+
+# ================================================== cache fingerprinting
+
+def test_callgraph_fingerprint_excludes_config_zoo(tmp_path):
+    """The thread-rule cache key covers every python file on the
+    call-graph surface and nothing else: a YAML zoo edit keeps the cache
+    warm, any context .py edit invalidates it."""
+    from fleetx_tpu.lint.core import Project
+    from fleetx_tpu.lint.rules.threads import callgraph_fingerprint
+
+    (tmp_path / "fleetx_tpu" / "configs").mkdir(parents=True)
+    mod = tmp_path / "m.py"
+    mod.write_text('"""Doc."""\n')
+    ctx = tmp_path / "fleetx_tpu" / "ctx.py"
+    ctx.write_text('"""Doc."""\nX = 1\n')
+    yml = tmp_path / "fleetx_tpu" / "configs" / "a.yaml"
+    yml.write_text("a: 1\n")
+
+    def fp():
+        return callgraph_fingerprint(Project(tmp_path, [mod]))
+
+    base = fp()
+    yml.write_text("a: 2\n")          # config-only edit: cache stays warm
+    assert fp() == base
+    ctx.write_text('"""Doc."""\nX = 2\n')   # call-graph edit: invalidate
+    assert fp() != base
+    # ... while the full project digest moves on BOTH edits
+    d1 = Project(tmp_path, [mod]).digest()
+    yml.write_text("a: 3\n")
+    assert Project(tmp_path, [mod]).digest() != d1
+
+
+def test_thread_rule_cache_roundtrip(tmp_path):
+    src_bad = textwrap.dedent('''
+        """Doc."""
+        import threading
+
+
+        class S:
+            """Doc."""
+
+            def __init__(self):
+                self.n = 0
+
+            def start(self):
+                """Doc."""
+                threading.Thread(target=self._w).start()
+
+            def _w(self):
+                """Doc."""
+                self.n += 1
+
+            def total(self):
+                """Doc."""
+                return self.n
+    ''')
+    mod = tmp_path / "m.py"
+    mod.write_text(src_bad)
+    cache = tmp_path / "cache.json"
+    kw = dict(root=tmp_path, select=THREAD_RULES, cache_path=cache)
+    first = run_lint([mod], **kw)
+    assert _rules_of(first) == ["unguarded-shared-state"]
+    warm = run_lint([mod], **kw)      # served from cache
+    assert [f.fingerprint for f in warm.findings] == \
+        [f.fingerprint for f in first.findings]
+    mod.write_text(src_bad.replace("self.n += 1", "pass"))
+    assert run_lint([mod], **kw).findings == []
+
+
+# ============================================================ CLI / SARIF
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "fleetx_lint_cli_threads", os.path.join(REPO, "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rules_flag_selects_by_code(tmp_path, monkeypatch, capsys):
+    repo = tmp_path / "repo"
+    (repo / "fleetx_tpu").mkdir(parents=True)
+    bad = repo / "fleetx_tpu" / "racy.py"
+    bad.write_text((
+        open(_fixture("fx014_unguarded.py")).read()))
+    cli = _load_cli()
+    monkeypatch.setattr(cli, "REPO_ROOT", str(repo))
+    monkeypatch.setattr(cli, "DEFAULT_BASELINE", str(repo / "baseline.json"))
+    monkeypatch.setattr(cli, "DEFAULT_CACHE", str(repo / ".lint_cache.json"))
+    assert cli.main(["--rules", "FX014,FX015"]) == 1
+    out = capsys.readouterr().out
+    assert "FX014" in out and "racy.py" in out
+    # --rules is select sugar: a filtered run must refuse --write-baseline
+    assert cli.main(["--rules", "FX014", "--write-baseline"]) == 2
+
+
+def test_sarif_includes_thread_rules():
+    res = run_lint([_fixture("fx014_unguarded.py")], root=FIXTURES,
+                   select=THREAD_RULES)
+    sarif = render_sarif(res)
+    run = sarif["runs"][0]
+    assert "FX014" in [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert run["results"][0]["ruleId"] == "FX014"
+
+
+# ===================================================== runtime sanitizer
+
+@pytest.fixture()
+def tsan_on(monkeypatch):
+    monkeypatch.setenv("FLEETX_TSAN", "1")
+    tsan.reset()
+    yield
+    tsan.reset()
+
+
+def test_tsan_disabled_is_plain_lock(monkeypatch):
+    monkeypatch.delenv("FLEETX_TSAN", raising=False)
+    lk = tsan.lock("x")
+    assert not isinstance(lk, tsan.SanLock)
+    with lk:
+        pass
+    obj = object()
+    tsan.register_object(obj, "o")
+    tsan.note_access(obj)             # no-ops when disabled
+    assert tsan.violations() == []
+
+
+def test_tsan_consistent_order_passes(tsan_on):
+    a, b = tsan.lock("order.a"), tsan.lock("order.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert tsan.violations() == []
+
+
+def test_tsan_inversion_raises_with_both_stacks(tsan_on):
+    a, b = tsan.lock("inv.a"), tsan.lock("inv.b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(tsan.LockOrderError) as err:
+        with b:
+            with a:
+                pass
+    msg = str(err.value)
+    assert "inv.a" in msg and "inv.b" in msg
+    assert "opposite order" in msg
+    assert tsan.violations()          # recorded for post-mortems too
+    assert not a._inner.locked()      # the failed acquire did not leak
+
+
+def test_tsan_cross_thread_access_flagged(tsan_on):
+    obj = type("Engine", (), {})()
+    tsan.register_object(obj, "engine")
+    tsan.note_access(obj, "same-thread")     # owner: fine
+    assert tsan.violations() == []
+    threading.Thread(target=tsan.note_access,
+                     args=(obj, "off-thread")).start()
+    for _ in range(100):
+        if tsan.violations():
+            break
+        import time
+        time.sleep(0.01)
+    vio = tsan.violations()
+    assert vio and "engine" in vio[0] and "off-thread" in vio[0]
+
+
+def test_tsan_cross_thread_under_sanitized_lock_ok(tsan_on):
+    obj = type("Engine", (), {})()
+    tsan.register_object(obj, "engine")
+    lk = tsan.lock("engine.guard")
+    done = threading.Event()
+
+    def worker():
+        with lk:
+            tsan.note_access(obj, "locked-touch")
+        done.set()
+
+    threading.Thread(target=worker).start()
+    assert done.wait(5.0)
+    assert tsan.violations() == []

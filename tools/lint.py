@@ -6,6 +6,7 @@ Usage::
     python tools/lint.py fleetx_tpu/core      # narrower scope
     python tools/lint.py --changed-only       # git-diff-aware selection
     python tools/lint.py --select docstrings  # one category
+    python tools/lint.py --rules FX014,FX015  # specific codes
     python tools/lint.py --json report.json   # machine-readable output
     python tools/lint.py --sarif report.sarif # CI inline annotations
     python tools/lint.py --write-baseline     # accept the current backlog
@@ -25,7 +26,9 @@ zoo (``fleetx_tpu/configs/**``, ``projects/**``) is a PROJECT-scope
 trigger: the full-tree scan runs AND the report is unrestricted, because
 a config edit can create findings in other files entirely (FX006's dead
 keys in code, FX011/FX012 shardcheck findings against
-``parallel/rules.py``).  Either way the content-fingerprint result cache
+``parallel/rules.py``).  A changed python file that touches threading
+constructs lifts the restriction the same way for the interprocedural
+thread rules (FX014-FX016).  Either way the content-fingerprint result cache
 (``.lint_cache.json``, disable with ``--no-cache``) keeps the grown
 repo's lint in seconds.
 """
@@ -78,6 +81,32 @@ def _config_zoo_changed(changed, config_dirs) -> bool:
                for rel in changed)
 
 
+def _thread_deps_changed(changed, repo) -> bool:
+    """True when a changed python file on the call-graph surface touches
+    threading constructs.  The FX014-FX016 findings are interprocedural —
+    moving a helper under a lock in one file can create (or clear) a race
+    finding in another — so such an edit lifts the changed-files report
+    restriction the way a config-zoo edit does for FX006/FX011.  Plain
+    .py edits that never mention a thread/lock keep the restriction (the
+    call-graph fingerprint in the thread rules' cache key still
+    invalidates the cached result either way)."""
+    from fleetx_tpu.lint.core import CONSUMER_DIRS
+
+    prefixes = tuple(d.rstrip("/") + "/" for d in CONSUMER_DIRS)
+    markers = ("threading.", "Thread(", "tsan.lock(", "_lock")
+    for rel in changed:
+        if not rel.endswith(".py") or not rel.startswith(prefixes):
+            continue
+        try:
+            with open(os.path.join(repo, rel), encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        if any(m in text for m in markers):
+            return True
+    return False
+
+
 def _shardcheck_deps_changed(changed) -> bool:
     """True when any changed file is in the shardcheck audit's dependency
     set (the registry, the audit driver, any model definition, …).
@@ -115,6 +144,9 @@ def main(argv=None) -> int:
                          "comma-separated)")
     ap.add_argument("--skip", action="append", default=[],
                     help="rule name/code/category to skip")
+    ap.add_argument("--rules", action="append", default=[],
+                    help="rule codes to run, e.g. --rules FX014,FX015 "
+                         "(sugar for --select; repeatable)")
     ap.add_argument("--baseline", metavar="PATH", default=None,
                     help=f"baseline file (default: {DEFAULT_BASELINE} "
                          "when it exists)")
@@ -138,10 +170,12 @@ def main(argv=None) -> int:
         return 0
 
     paths = args.paths or [os.path.join(REPO_ROOT, "fleetx_tpu")]
-    select = [t.strip() for s in args.select for t in s.split(",") if t.strip()]
+    select = [t.strip() for s in args.select + args.rules
+              for t in s.split(",") if t.strip()]
     skip = [t.strip() for s in args.skip for t in s.split(",") if t.strip()]
 
-    if args.write_baseline and (select or skip or args.changed_only):
+    if args.write_baseline and (select or skip or args.rules
+                                or args.changed_only):
         # a filtered run would overwrite the baseline with a subset,
         # silently dropping every unselected rule's (or unchanged file's)
         # accepted findings
@@ -169,7 +203,8 @@ def main(argv=None) -> int:
             # FX006/shardcheck zoo; model/registry edits create findings
             # anchored to config paths that a restricted report would drop)
             config_trigger = _config_zoo_changed(changed, CONFIG_DIRS) or \
-                _shardcheck_deps_changed(changed)
+                _shardcheck_deps_changed(changed) or \
+                _thread_deps_changed(changed, REPO_ROOT)
             changed = [rel for rel in changed
                        if any(rel == p or rel.startswith(p.rstrip("/") + "/")
                               for p in scope_prefixes)]
