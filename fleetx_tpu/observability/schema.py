@@ -80,6 +80,12 @@ SERVING_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "requests_admitted": ((int,), True),
     "requests_completed": ((int,), True),
     "requests_refused": ((int,), True),
+    # lazy-lifecycle counters (PR 18): pool-pressure swap-outs and which
+    # decode attention program this engine compiled ("paged_kernel" when
+    # the Pallas kernel's support predicates admitted the config/mesh,
+    # "gather" for the dense fallback)
+    "requests_preempted": ((int,), False),
+    "decode_path": ((str,), False),
     "queue_depth": (_NULLABLE_INT, True),
     "active_requests": (_NULLABLE_INT, True),
     "page_occupancy": (_NULLABLE_NUM, True),

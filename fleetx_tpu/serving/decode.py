@@ -21,12 +21,17 @@ continuous batching is. Math is kept line-for-line parallel (f32
 layernorms, cfg-dtype matmuls, f32 softmax, gelu ``approximate=True``) so
 greedy decode is token-identical to one-shot ``generation.generate``.
 
-Gather/scatter shape: attention materialises the gathered dense view
-``pool[block_tables] → [B, pages_per_req·page_size, heads, head_dim]``
-inside the jit and lets XLA fuse it; a production TPU build would replace
-that with a Pallas paged-attention kernel that walks block tables in-kernel
-(see ``/opt/skills/guides/pallas_guide.md``), which changes none of the
-host-side machinery here.
+Decode attention has two compiled forms, chosen ONCE at
+``make_step_fns`` time (so the jit caches still hold one entry each):
+the ``ops/paged_attention.py`` Pallas kernel that walks block tables
+in-kernel (scalar-prefetched page ids, online-softmax f32 accumulation —
+no dense page view ever materialises), or — when
+``paged_kernel_enabled`` rejects the geometry — the original gathered
+view ``pool[block_tables] → [B, pages_per_req·page_size, heads,
+head_dim]`` fused by XLA. Prefill always takes the gather (its queries
+span a whole chunk, not one token). Host-side machinery is identical on
+both paths, and greedy decode is token-identical either way
+(``tests/test_zz_serving.py`` pins parity AND which path compiled).
 
 Quantized decode (``ServingConfig.quantize_decode``): int8-style fake-quant
 on the decode activations (``Quantization.activation_bits`` →
@@ -46,6 +51,34 @@ import jax
 import jax.numpy as jnp
 
 from fleetx_tpu.models.gpt import generation as G
+from fleetx_tpu.ops import paged_attention as PA
+
+
+def paged_kernel_enabled(cfg: Any, *, page_size: int, num_pages: int,
+                         pages_per_req: int,
+                         pool_sharding: Optional[Any] = None) -> bool:
+    """Static kernel-vs-gather decision for one engine's geometry.
+
+    True when the Pallas page-walk kernel serves decode: the shape
+    predicate admits the (heads, head_dim, page) tiling, and — under a
+    mesh that actually shards the pool — the per-device ``shard_map``
+    wrapping applies too. Consulted once per engine; the result is baked
+    into the decode program so the no-retrace pin is untouched.
+    """
+    if not PA.paged_attention_supported(
+            num_heads=cfg.num_attention_heads, head_dim=cfg.head_dim,
+            page_size=page_size, pages_per_req=pages_per_req,
+            dtype=cfg.dtype):
+        return False
+    if pool_sharding is not None:
+        mesh = pool_sharding.mesh
+        sharded = any(dict(mesh.shape).get(a, 1) > 1
+                      for a in ("fsdp", "tensor"))
+        if sharded and not PA.paged_sharded_supported(
+                mesh, num_heads=cfg.num_attention_heads,
+                num_pages=num_pages):
+            return False
+    return True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,15 +134,18 @@ def _paged_attention(q: jax.Array, kd: jax.Array, vd: jax.Array,
 
 def _forward(params: Any, cfg: Any, tokens: jax.Array, positions: jax.Array,
              pool_k: jax.Array, pool_v: jax.Array, block_tables: jax.Array,
-             quantize: bool) -> tuple[jax.Array, jax.Array, jax.Array]:
+             quantize: bool, paged_kernel: bool = False,
+             mesh: Optional[Any] = None
+             ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Forward a ``[B, S]`` token block through the paged decode stack.
 
     Writes the block's K/V into the pool (scatter by block table), then
-    runs attention per layer against the gathered page view. Returns
-    ``(hidden [B, S, h], pool_k, pool_v)``. ``positions`` are absolute
-    token positions (invalid slots must already be redirected to the null
-    page via ``block_tables``-aware ``positions``/page math by the
-    caller-built scatter indices below).
+    runs attention per layer: the Pallas page-walk kernel when
+    ``paged_kernel`` is set (decode only — ``S == 1``), the gathered page
+    view otherwise. Returns ``(hidden [B, S, h], pool_k, pool_v)``.
+    ``positions`` are absolute token positions (invalid slots must
+    already be redirected to the null page via ``block_tables``-aware
+    ``positions``/page math by the caller-built scatter indices below).
     """
     B, S = tokens.shape
     ps = pool_k.shape[2]
@@ -146,9 +182,19 @@ def _forward(params: Any, cfg: Any, tokens: jax.Array, positions: jax.Array,
 
         pk_l = pk_l.at[pages, offs].set(k)
         pv_l = pv_l.at[pages, offs].set(v)
-        kd = pk_l[block_tables].reshape(B, -1, nh, hd)
-        vd = pv_l[block_tables].reshape(B, -1, nh, hd)
-        attn = _paged_attention(q, kd, vd, q_pos)
+        if paged_kernel and S == 1:
+            # in-kernel block-table walk (ops/paged_attention.py): the
+            # pool is read page-by-page via scalar-prefetched ids — the
+            # dense [B, pages_per_req·page_size, nh, hd] view is never
+            # materialised. positions[:, 0] is each row's query position
+            # (< 0 = inactive slot → all pages masked, exact-zero out).
+            attn = PA.paged_attention_sharded(
+                q[:, 0], pk_l, pv_l, block_tables, positions[:, 0],
+                mesh=mesh)[:, None]
+        else:
+            kd = pk_l[block_tables].reshape(B, -1, nh, hd)
+            vd = pv_l[block_tables].reshape(B, -1, nh, hd)
+            attn = _paged_attention(q, kd, vd, q_pos)
 
         attn = _quant(attn, act_bits, quantize)
         out_k = _quant(lp["attn"]["out_kernel"].astype(cfg.dtype), w_bits,
@@ -201,7 +247,8 @@ def _sample(logits: jax.Array, rng: jax.Array,
 def make_step_fns(cfg: Any, *, max_batch: int, pages_per_req: int,
                   prefill_chunk: int, sampling: SamplingParams,
                   quantize: bool = False,
-                  pool_sharding: Optional[Any] = None) -> dict:
+                  pool_sharding: Optional[Any] = None,
+                  paged_kernel: bool = False) -> dict:
     """Build the two jitted serving programs for one engine.
 
     Returns ``{"prefill": fn, "decode": fn}``; both donate the pool
@@ -210,7 +257,10 @@ def make_step_fns(cfg: Any, *, max_batch: int, pages_per_req: int,
     in, so the jit caches hold exactly one entry each for the engine's
     lifetime. ``pool_sharding`` (a ``NamedSharding``) keeps the pools
     constrained to their mesh placement through every step.
+    ``paged_kernel`` bakes the decode-attention path in (callers gate on
+    ``paged_kernel_enabled`` — this function obeys, it doesn't decide).
     """
+    mesh = pool_sharding.mesh if pool_sharding is not None else None
 
     def constrain(pool):
         if pool_sharding is None:
@@ -240,7 +290,8 @@ def make_step_fns(cfg: Any, *, max_batch: int, pages_per_req: int,
         block tables); returns pools + sampled tokens + f32 logits."""
         positions = jnp.where(lens >= 0, lens, -1)[:, None]
         x, pool_k, pool_v = _forward(params, cfg, tokens[:, None], positions,
-                                     pool_k, pool_v, block_tables, quantize)
+                                     pool_k, pool_v, block_tables, quantize,
+                                     paged_kernel=paged_kernel, mesh=mesh)
         logits = _logits(params, cfg, x[:, 0])
         return (constrain(pool_k), constrain(pool_v),
                 _sample(logits, rng, sampling), logits)

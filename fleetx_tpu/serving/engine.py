@@ -5,18 +5,26 @@ jitted step programs from ``serving/decode.py``) and the host state (slot
 table, block tables, page allocator, request queues). The scheduler runs
 the vLLM-style loop, one ``step()`` per iteration:
 
-1. **admit** — waiting requests take a free decode slot + an up-front page
-   reservation (``ceil((prompt + max_new) / page_size)`` pages); requests
-   the pool could NEVER hold are refused at ``submit`` (OOM admission
-   refusal), requests that merely don't fit *right now* wait;
+1. **admit** — waiting requests take a free decode slot + a **lazy** page
+   grant: the prompt's pages plus ``alloc_watermark`` headroom pages
+   (vLLM-style; ``lazy_alloc: false`` restores the old reserve-up-front
+   ``ceil((prompt + max_new) / page_size)`` for A/B measurement).
+   Requests the pool could NEVER hold are refused at ``submit`` (OOM
+   admission refusal), requests that merely don't fit *right now* wait;
 2. **prefill** — ONE chunk (``prefill_chunk`` tokens) of the oldest
    prefilling request is forwarded; long prompts therefore spread over
    several steps instead of stalling the decode batch, and the final
    chunk's logits yield the request's first token (TTFT);
 3. **decode** — one token for every RUNNING slot in a single static-shape
-   step; new requests join at the next step boundary, finished ones
-   (eos / ``max_new_tokens``) free their pages and leave — no retrace in
-   either direction.
+   step; each running request's block table grows one page at a time as
+   its length crosses page boundaries, and when the pool runs dry the
+   YOUNGEST live request is **preempted**: pages freed, state reset,
+   re-enqueued at the head of the admission queue (decode is idempotent —
+   the re-run regenerates the same greedy tokens, the loss-free-recovery
+   property the router's re-dispatch already relies on). New requests
+   join at the next step boundary, finished ones (eos /
+   ``max_new_tokens``) free their pages and leave — no retrace in any
+   direction.
 
 Telemetry rides the PR 1 metrics registry (``serving_ttft`` /
 ``serving_inter_token`` histograms; queue-depth / active-request /
@@ -46,7 +54,8 @@ from fleetx_tpu.observability import flight, tsan
 from fleetx_tpu.observability.flight import EventRing
 from fleetx_tpu.observability.metrics import get_registry
 from fleetx_tpu.observability.slo import SLORegistry
-from fleetx_tpu.serving.decode import SamplingParams, make_step_fns
+from fleetx_tpu.serving.decode import (SamplingParams, make_step_fns,
+                                       paged_kernel_enabled)
 from fleetx_tpu.serving.paged_cache import (NULL_PAGE, PageAllocator,
                                             init_pool, pool_shardings)
 from fleetx_tpu.utils.log import logger
@@ -66,6 +75,21 @@ class ServingConfig:
     max_seq_len: int = 0        # 0 → model max_position_embeddings
     prefill_chunk: int = 32     # prompt tokens forwarded per step
     quantize_decode: bool = False  # int8-act decode (Quantization bits)
+    # decode attention path: when True AND ``ops/paged_attention.py``'s
+    # support predicates admit this (head geometry, VMEM tile budget,
+    # pool divisibility on a sharded mesh), decode runs the in-kernel
+    # Pallas paged attention — no ``[B, pages*page_size]`` gather
+    # materialization. Falls back to the gather path otherwise. The
+    # choice is made ONCE at engine construction so the jit cache stays
+    # pinned at one decode program (the no-retrace contract).
+    paged_kernel: bool = True
+    # page lifecycle: True (default) admits on prompt pages +
+    # ``alloc_watermark`` headroom and grows page-by-page during decode,
+    # preempting the youngest request when the pool runs dry; False
+    # restores reserve-up-front (``prompt + max_new`` pages at admission)
+    # for A/B measurement
+    lazy_alloc: bool = True
+    alloc_watermark: int = 1    # headroom pages granted at lazy admission
     # checkpoint directory to restore params from (tools/serve.py feeds it
     # through the PR 7 integrity-verified loader, restoring each leaf
     # DIRECTLY onto its registry sharding when the replica runs a mesh);
@@ -113,6 +137,10 @@ class ServingRequest:
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # admission recency: monotonically minted at every (re-)admission —
+    # the preemption policy's youngest-first ordering key
+    admit_seq: int = -1
+    preemptions: int = 0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -124,10 +152,13 @@ class ServingRequest:
 
 #: lifecycle event taxonomy (docs/serving.md "Observability") — the order
 #: a healthy request walks them; ``refused`` replaces the admitted→finished
-#: span for drain/OOM refusals, ``drain`` marks a preemption landing while
-#: the request was live
+#: span for drain/OOM refusals, ``drain`` marks a replica preemption
+#: landing while the request was live, ``page_grow`` stamps each lazy
+#: block-table extension, and ``preempted`` marks a pool-pressure swap-out
+#: (the request loops back to ``admitted`` afterwards)
 TIMELINE_EVENTS = ("queued", "admitted", "prefill_chunk", "first_token",
-                   "decode_tick", "finished", "refused", "drain")
+                   "decode_tick", "page_grow", "preempted", "finished",
+                   "refused", "drain")
 
 #: milestone events whose first timestamp is pinned outside the ring so
 #: attribution survives decode-tick eviction on long generations
@@ -284,11 +315,20 @@ class ServingEngine:
             sharding = pool_shardings(mesh)
             self.pool_k = jax.device_put(self.pool_k, sharding)
             self.pool_v = jax.device_put(self.pool_v, sharding)
+        # kernel-vs-gather is decided HERE, once: the support predicates
+        # are static functions of the config/pool/mesh, so the decode
+        # program compiles exactly one attention path and the jit cache
+        # stays pinned at one entry (test_serving pins this)
+        self.paged_kernel_active = bool(sc.paged_kernel) and \
+            paged_kernel_enabled(
+                model_cfg, page_size=sc.page_size, num_pages=sc.num_pages,
+                pages_per_req=self.pages_per_req, pool_sharding=sharding)
         self._fns = make_step_fns(
             model_cfg, max_batch=sc.max_batch,
             pages_per_req=self.pages_per_req,
             prefill_chunk=sc.prefill_chunk, sampling=self.sampling,
-            quantize=bool(sc.quantize_decode), pool_sharding=sharding)
+            quantize=bool(sc.quantize_decode), pool_sharding=sharding,
+            paged_kernel=self.paged_kernel_active)
 
         # host-side scheduler state
         self._slots: list = [None] * sc.max_batch
@@ -307,6 +347,9 @@ class ServingEngine:
         # request counter used to recycle ids across bench windows,
         # silently merging two requests' timelines and router bookkeeping)
         self._rid_counter = 0
+        # admission recency mint for the preempt-youngest policy; never
+        # reset, so ordering survives bench-window stat resets too
+        self._admit_seq = 0
         # engine-local gauge freshness: the registry is process-global, so
         # a prior engine's gauge values must not read as THIS engine's
         self._gauges_current = False
@@ -322,9 +365,12 @@ class ServingEngine:
         logger.info(
             "serving engine: max_batch=%d pages=%d x %d tokens "
             "(capacity %d token slots/layer), prefill_chunk=%d, "
-            "quantize_decode=%s", sc.max_batch, self.allocator.usable_pages,
+            "quantize_decode=%s, decode=%s, alloc=%s",
+            sc.max_batch, self.allocator.usable_pages,
             sc.page_size, self.allocator.usable_pages * sc.page_size,
-            sc.prefill_chunk, bool(sc.quantize_decode))
+            sc.prefill_chunk, bool(sc.quantize_decode),
+            "paged_kernel" if self.paged_kernel_active else "gather",
+            "lazy" if sc.lazy_alloc else "reserve")
 
     # ------------------------------------------------------------ submission
     def submit(self, prompt: list, max_new_tokens: int,
@@ -374,21 +420,36 @@ class ServingEngine:
 
     # -------------------------------------------------------------- schedule
     def _admit(self) -> None:
-        """Waiting → prefill while a slot AND a full page reservation fit
-        (strict FIFO: head-of-line blocking keeps admission fair)."""
+        """Waiting → prefill while a slot AND a page grant fit (strict
+        FIFO: head-of-line blocking keeps admission fair).
+
+        The grant is the admission policy: lazy (default) asks for the
+        prompt's pages plus ``alloc_watermark`` headroom — decode grows
+        the rest page-by-page in ``_grow_or_preempt`` — while
+        ``lazy_alloc: false`` reserves the worst case up front. Both are
+        capped at the worst case, so a zero-decode request never
+        over-reserves."""
+        sc = self.serving
         while self._waiting:
             req = self._waiting[0]
             try:
                 slot = self._slots.index(None)
             except ValueError:
                 return
-            need = self.allocator.pages_needed(
+            worst = self.allocator.pages_needed(
                 len(req.prompt) + req.max_new_tokens)
+            if sc.lazy_alloc:
+                need = min(self.allocator.pages_needed(len(req.prompt))
+                           + max(int(sc.alloc_watermark), 0), worst)
+            else:
+                need = worst
             pages = self.allocator.alloc(need)
             if pages is None:
                 return
             self._waiting.popleft()
             req.state, req.slot, req.pages = PREFILL, slot, pages
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
             self._slots[slot] = req
             self._block_tables[slot] = NULL_PAGE
             self._block_tables[slot, :need] = pages
@@ -438,8 +499,78 @@ class ServingEngine:
                 flight.note("serving", "first_token", id=req.id)
         return True
 
+    def _grow_or_preempt(self) -> None:
+        """Extend each RUNNING request's block table to cover the token
+        the next decode step will write; when the pool is dry, preempt
+        the YOUNGEST live request and retry.
+
+        Preempting youngest (highest ``admit_seq``) keeps the oldest
+        request making forward progress, which bounds the scheme: each
+        preemption frees at least one page, live requests always hold at
+        least one, and the head of the FIFO eventually finishes — no
+        livelock. A request can preempt ITSELF (it was the youngest);
+        it simply sits out this decode step and re-enters the queue."""
+        for req in list(self._slots):
+            if req is None or req.state != RUNNING:
+                continue  # freed or preempted earlier in this pass
+            need = self.allocator.pages_needed(int(self._lens[req.slot]) + 1)
+            while len(req.pages) < need:
+                got = self.allocator.alloc(1)
+                if got is not None:
+                    self._block_tables[req.slot, len(req.pages)] = got[0]
+                    req.pages.extend(got)
+                    self.timelines.note(
+                        req.id, "page_grow", pages=len(req.pages),
+                        occupancy=self.allocator.occupancy())
+                    continue
+                victim = self._youngest_live()
+                if victim is None:
+                    break  # unreachable: req itself is live
+                self._preempt(victim)
+                if victim is req:
+                    break
+
+    def _youngest_live(self) -> Optional[ServingRequest]:
+        """The most recently admitted request still holding pages."""
+        live = [r for r in self._slots if r is not None]
+        return max(live, key=lambda r: r.admit_seq, default=None)
+
+    def _preempt(self, req: ServingRequest) -> None:
+        """Swap ``req`` out: free its pages and re-enqueue it at the HEAD
+        of the admission queue with all generation state reset — decode
+        is deterministic (greedy or seeded), so the re-run regenerates
+        the same tokens and the caller never observes the eviction beyond
+        latency."""
+        tsan.note_access(self, "preempt")
+        pages_freed = len(req.pages)
+        self.allocator.free(req.pages)
+        slot = req.slot
+        self._slots[slot] = None
+        self._block_tables[slot] = NULL_PAGE
+        self._lens[slot] = -1
+        self._last_tokens[slot] = 0
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+        req.state, req.slot, req.pages = WAITING, -1, []
+        req.prefill_pos = 0
+        req.tokens = []
+        req.first_token_at = None
+        req.last_token_at = None
+        req.preemptions += 1
+        # head-of-queue re-entry: victims are picked youngest-first, so
+        # appendleft keeps the relative admission order among them
+        self._waiting.appendleft(req)
+        self.metrics.counter("serving_requests_preempted").inc()
+        self.timelines.note(req.id, "preempted", pages_freed=pages_freed,
+                            occupancy=self.allocator.occupancy(),
+                            preemptions=req.preemptions)
+        flight.note("serving", "preempt", id=req.id,
+                    pages_freed=pages_freed)
+
     def _decode_step(self) -> bool:
         """One token for every RUNNING slot (static batch; masked rows)."""
+        if self.serving.lazy_alloc:
+            self._grow_or_preempt()
         running = [r for r in self._slots
                    if r is not None and r.state == RUNNING]
         if not running:
@@ -560,7 +691,8 @@ class ServingEngine:
         clock — the bench calls this after its warmup request so compile
         time never pollutes tokens/s or the latency quantiles."""
         for name in ("serving_requests_total", "serving_requests_completed",
-                     "serving_requests_refused", "serving_tokens_total"):
+                     "serving_requests_refused", "serving_requests_preempted",
+                     "serving_tokens_total"):
             self.metrics.counter(name).reset()
         for name in ("serving_ttft", "serving_inter_token",
                      "serving_prefill_step", "serving_decode_step"):
@@ -621,6 +753,10 @@ class ServingEngine:
             "requests_completed": completed,
             "requests_refused": int(
                 m.counter("serving_requests_refused").value),
+            "requests_preempted": int(
+                m.counter("serving_requests_preempted").value),
+            "decode_path": ("paged_kernel" if self.paged_kernel_active
+                            else "gather"),
             **gauges,
             "tokens_total": int(tokens),
             "tokens_per_sec": tokens / wall,

@@ -34,8 +34,22 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fleetx_tpu.observability import tsan
+
 #: reserved scratch page — never allocated, always masked when read
 NULL_PAGE = 0
+
+
+class PageAllocatorError(ValueError):
+    """A page-accounting violation: double-free, freeing a page that was
+    never handed out, or an invalid (non-positive) allocation size.
+
+    A real exception, NOT an ``assert`` — under ``python -O`` an assert
+    vanishes and a double-free silently corrupts the free list (the same
+    page handed to two requests ⇒ cross-request KV corruption). Exhaustion
+    is NOT an error: ``alloc`` returns None for that, and the scheduler's
+    preempt-and-swap path handles it.
+    """
 
 
 def init_pool(cfg: Any, num_pages: int, page_size: int,
@@ -69,12 +83,22 @@ def pool_shardings(mesh: Mesh) -> NamedSharding:
 class PageAllocator:
     """Host-side free-list allocator over the pool's page ids.
 
-    Admission policy is **reserve-up-front**: the engine allocates every
-    page a request could ever need (``ceil((prompt + max_new) / page_size)``)
-    at admission, so a running request can never hit a mid-decode OOM and
-    no preemption/swap machinery is needed. The cost is internal
-    fragmentation (tail-page slots reserved but not yet written), which
-    ``internal_fragmentation`` reports so the occupancy gauge stays honest.
+    The engine's default admission policy is **lazy** (vLLM-style): a
+    request is admitted on its prompt pages plus a small headroom
+    watermark, grows one page at a time as decode crosses page
+    boundaries, and the scheduler preempts the youngest request when the
+    pool runs dry (``ServingEngine._grow_or_preempt``). The allocator
+    itself is policy-free — it hands out and reclaims page ids,
+    all-or-nothing, and raises :class:`PageAllocatorError` on any
+    accounting violation. ``internal_fragmentation`` reports
+    reserved-but-unwritten slack so the occupancy gauge stays honest
+    under either policy (reserve-up-front remains available via
+    ``ServingConfig.lazy_alloc = False`` for A/B measurement).
+
+    Thread-confinement: the allocator is owned by the engine's scheduler
+    thread; ``FLEETX_TSAN=1`` (``observability/tsan.py``) flags any
+    cross-thread alloc/free — the preemption path mutates free-list state
+    mid-decode, so the kill-one drill runs it sanitized.
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -84,6 +108,7 @@ class PageAllocator:
         # LIFO free list → recently-freed (cache-warm) pages are reused first
         self._free = list(range(self.num_pages - 1, NULL_PAGE, -1))
         self._allocated: set[int] = set()
+        tsan.register_object(self, "page-allocator")
 
     # ------------------------------------------------------------- capacity
     @property
@@ -114,18 +139,39 @@ class PageAllocator:
 
     # ------------------------------------------------------------ alloc/free
     def alloc(self, n: int) -> Optional[list[int]]:
-        """Allocate ``n`` pages, or None (leaving state untouched) when the
-        free list cannot satisfy the request — never a partial grant."""
-        if n <= 0 or n > len(self._free):
+        """Allocate ``n`` pages, or None (leaving state untouched) when
+        the free list cannot satisfy the request — never a partial grant.
+
+        The two failure modes are distinct on purpose: exhaustion (the
+        pool is merely full right now) returns None so schedulers can
+        wait or preempt, while ``n <= 0`` raises
+        :class:`PageAllocatorError` — a zero/negative ask is a caller
+        bug, and conflating it with exhaustion used to make "admit on 0
+        prompt pages" look like an OOM.
+        """
+        tsan.note_access(self, "alloc")
+        if n <= 0:
+            raise PageAllocatorError(f"invalid allocation size {n}")
+        if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
         self._allocated.update(pages)
         return pages
 
     def free(self, pages: list[int]) -> None:
-        """Return ``pages`` to the free list (double-free is an error)."""
+        """Return ``pages`` to the free list.
+
+        Raises :class:`PageAllocatorError` on a page that is not
+        currently allocated (double-free / never-allocated / null page) —
+        state up to the offending page is already returned, so this is a
+        crash-the-replica signal, not a recoverable one.
+        """
+        tsan.note_access(self, "free")
         for p in pages:
-            assert p in self._allocated, f"freeing unallocated page {p}"
+            if p not in self._allocated:
+                raise PageAllocatorError(
+                    f"freeing unallocated page {p} (double-free or foreign "
+                    f"id); {len(self._allocated)} pages currently out")
             self._allocated.discard(p)
             self._free.append(p)
 

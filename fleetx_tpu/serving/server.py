@@ -218,6 +218,10 @@ class ReplicaServer:
         # must reach engine state only via the submission/control queues,
         # and FLEETX_TSAN=1 flags any direct touch
         tsan.register_object(self.engine, "serving-engine")
+        # the allocator moves with its engine: the preemption path frees
+        # and re-grants pages mid-decode, so the kill-one drill runs it
+        # under the same thread-confinement sanitizer
+        tsan.register_object(self.engine.allocator, "page-allocator")
         work_steps = 0
         while True:
             if preemption is not None and preemption.triggered and \

@@ -205,9 +205,10 @@ def test_join_mid_stream_and_never_retraces(small_model, engine):
 
 
 def test_admission_oom_refusal_queueing_and_drain(small_model):
-    """Permanently-oversized requests refuse at submit; requests that
-    merely don't fit NOW wait for pages; drain refuses new work but
-    finishes everything admitted."""
+    """Permanently-oversized requests refuse at submit (the worst-case
+    bound holds even under lazy admission); requests that merely don't
+    fit NOW wait for pages; drain refuses new work but finishes
+    everything admitted."""
     cfg, _, params = small_model
     eng = ServingEngine(
         cfg, params,
@@ -219,14 +220,19 @@ def test_admission_oom_refusal_queueing_and_drain(small_model):
     r_oom = eng.submit([1] * 9, 8, request_id="oom")
     assert r_oom.state == "refused" and "oom" in r_oom.error
     # 16 tokens fit max_seq_len but need 4 pages > 3 usable → refusal too
+    # (refusal keys off the WORST case, not the lazy admission grant: a
+    # request the pool could only hold by preempting forever is refused)
     r_oom2 = eng.submit([1] * 8, 8, request_id="oom2")
     assert r_oom2.state == "refused" and "oom" in r_oom2.error
 
-    r1 = eng.submit([5, 9, 23, 41], 8, request_id="r1")   # 3 pages
-    r2 = eng.submit([7, 3], 8, request_id="r2")           # 3 pages → waits
+    r1 = eng.submit([5, 9, 23, 41], 8, request_id="r1")
+    r2 = eng.submit([7, 3], 8, request_id="r2")
     eng.step()
     assert r1.state in ("prefill", "running")
-    assert r2.state == "waiting"  # only 1 page free — r2 must wait
+    # lazy grant: prompt page + 1 watermark page, NOT the 3-page worst
+    # case reserve-up-front would take
+    assert len(r1.pages) == 2
+    assert r2.state == "waiting"  # only 1 page free — r2 (needs 2) waits
     assert eng.metrics.gauge("serving_queue_depth").value == 1
 
     eng.begin_drain()
@@ -363,6 +369,237 @@ def test_registry_sharded_weights_compose_with_sharded_pool(devices8,
 
 
 # ---------------------------------------------------------------------------
+# in-kernel paged attention: path pins, predicate, fallback (PR 18)
+# ---------------------------------------------------------------------------
+
+def _decode_jaxpr(eng):
+    """The traced decode program (pins which attention path compiled)."""
+    return str(jax.make_jaxpr(eng._fns["decode"])(
+        eng.params, eng.pool_k, eng.pool_v, eng._last_tokens,
+        eng._block_tables, eng._lens, jax.random.PRNGKey(0)))
+
+
+def test_null_page_constant_pinned_across_modules():
+    """ops/paged_attention.py keeps a LOCAL copy of NULL_PAGE (no import
+    cycle into serving); this pin is what makes that copy safe."""
+    from fleetx_tpu.ops import paged_attention as PA
+
+    assert PA.NULL_PAGE == NULL_PAGE
+
+
+def test_paged_attention_support_predicate():
+    from fleetx_tpu.ops import paged_attention as PA
+
+    ok = dict(num_heads=4, head_dim=16, page_size=4, pages_per_req=8)
+    assert PA.paged_attention_supported(**ok)
+    assert not PA.paged_attention_supported(
+        **dict(ok, head_dim=12))         # not a multiple of 8
+    assert not PA.paged_attention_supported(
+        **dict(ok, head_dim=512))        # over the lane budget
+    assert not PA.paged_attention_supported(
+        **dict(ok), dtype=jnp.float16)   # unsupported pool dtype
+    assert PA.paged_attention_supported(**dict(ok), dtype=jnp.bfloat16)
+
+
+def test_kernel_vs_gather_parity_and_compiled_path_pinned(small_model):
+    """The SAME prompts through a kernel engine and a forced-gather
+    engine decode token-identically to the one-shot reference, and the
+    jaxpr pins which attention path each engine compiled — a silent
+    fallback (predicate regression) fails here, not in a perf chart."""
+    cfg, model, params = small_model
+    prompts = [[5, 9, 23, 41], [7, 3],
+               [11, 2, 8, 4, 19, 33, 7, 6, 1, 2, 3]]  # chunked prefill
+    want = one_shot(model, params, prompts, 6)
+
+    def run(paged_kernel):
+        eng = ServingEngine(
+            cfg, params,
+            ServingConfig(max_batch=4, page_size=4, num_pages=33,
+                          max_seq_len=32, prefill_chunk=4,
+                          paged_kernel=paged_kernel),
+            eos_token_id=EOS)
+        reqs = [eng.submit(p, 6, request_id=f"k{int(paged_kernel)}{i}")
+                for i, p in enumerate(prompts)]
+        eng.run_until_drained()
+        return eng, reqs
+
+    eng_k, reqs_k = run(True)
+    eng_g, reqs_g = run(False)
+    assert eng_k.paged_kernel_active and not eng_g.paged_kernel_active
+    for req, row in zip(reqs_k, want):
+        check_parity(req, row)
+    for req, row in zip(reqs_g, want):
+        check_parity(req, row)
+    # path pin: exactly the requested attention compiled into decode
+    assert "pallas_call" in _decode_jaxpr(eng_k)
+    assert "pallas_call" not in _decode_jaxpr(eng_g)
+    # prefill stays gather on BOTH engines (S>1 chunks)
+    assert eng_k._fns["decode"]._cache_size() == 1  # no-retrace pin holds
+
+
+def test_kernel_predicate_rejects_config_and_falls_back(small_model):
+    """A head_dim the kernel cannot tile (15 — not a multiple of 8) must
+    quietly compile the gather path even with paged_kernel requested, at
+    full token parity."""
+    from flax.core import meta
+
+    bad_cfg = config_from_dict(dict(MODEL_DICT, hidden_size=60))  # hd 15
+    model = GPTForPretraining(bad_cfg)
+    params = meta.unbox(model.init({"params": jax.random.PRNGKey(0)},
+                                   jnp.zeros((1, 8), jnp.int32), None,
+                                   deterministic=True)["params"])
+    eng = ServingEngine(
+        bad_cfg, params,
+        ServingConfig(max_batch=2, page_size=4, num_pages=17,
+                      max_seq_len=32, prefill_chunk=4, paged_kernel=True),
+        eos_token_id=EOS)
+    assert not eng.paged_kernel_active
+    want = one_shot(model, params, [[5, 9, 23]], 6)
+    req = eng.submit([5, 9, 23], 6, request_id="fb")
+    eng.run_until_drained()
+    check_parity(req, want[0])
+    assert "pallas_call" not in _decode_jaxpr(eng)
+
+
+def test_sharded_pool_runs_kernel_path(small_model, devices8):
+    """The fsdp/tensor-sharded pool admits the kernel (page and head
+    counts divide the mesh) and compiles it — the sharded parity test
+    above then covers its token output."""
+    from fleetx_tpu.parallel.mesh import build_mesh
+
+    cfg, model, params = small_model
+    mesh = build_mesh({"fsdp_degree": 2, "mp_degree": 2})
+    eng = ServingEngine(
+        cfg, params,
+        ServingConfig(max_batch=2, page_size=4, num_pages=32,
+                      max_seq_len=32, prefill_chunk=4),
+        eos_token_id=EOS, mesh=mesh)
+    assert eng.paged_kernel_active
+    want = one_shot(model, params, [[5, 9, 23, 41]], 6)
+    req = eng.submit([5, 9, 23, 41], 6, request_id="shk")
+    eng.run_until_drained()
+    check_parity(req, want[0])
+    assert "pallas_call" in _decode_jaxpr(eng)
+
+
+# ---------------------------------------------------------------------------
+# lazy page lifecycle: admission, growth, preempt-and-swap (PR 18)
+# ---------------------------------------------------------------------------
+
+def test_lazy_admission_admits_strictly_more_than_reserve(small_model):
+    """The tentpole's occupancy claim: on the SAME pool, lazy admission
+    runs strictly more concurrent requests than reserve-up-front."""
+    cfg, _, params = small_model
+
+    def admitted_after_first_step(lazy):
+        eng = ServingEngine(
+            cfg, params,
+            ServingConfig(max_batch=4, page_size=4, num_pages=9,  # 8 usable
+                          max_seq_len=32, prefill_chunk=4,
+                          lazy_alloc=lazy),
+            eos_token_id=EOS)
+        for i in range(4):
+            eng.submit([5 + i, 9, 23, 41], 8, request_id=f"a{lazy}{i}")
+        eng.step()
+        return sum(r is not None for r in eng._slots)
+
+    reserve = admitted_after_first_step(False)  # 3 pages each → 2 fit
+    lazy = admitted_after_first_step(True)      # 1 + watermark → all 4 fit
+    assert reserve == 2 and lazy == 4
+    assert lazy > reserve
+
+
+def test_pool_exhaustion_preempts_youngest_and_completes_token_identical(
+        small_model):
+    """The preempt-and-swap drill: an over-admitted pool runs dry
+    mid-decode; the YOUNGEST request is swapped out, re-enqueued at the
+    queue head, and still completes token-identical (decode is
+    idempotent) — nothing leaks and the oldest request is never the
+    victim."""
+    cfg, model, params = small_model
+    eng = ServingEngine(
+        cfg, params,
+        ServingConfig(max_batch=4, page_size=4, num_pages=9,  # 8 usable
+                      max_seq_len=32, prefill_chunk=4),
+        eos_token_id=EOS)
+    eng.reset_stats()
+    prompts = [[5 + i, 9, 23, 41] for i in range(4)]
+    want = one_shot(model, params, prompts, 8)
+    reqs = [eng.submit(p, 8, request_id=f"pe{i}")
+            for i, p in enumerate(prompts)]
+    eng.run_until_drained()
+    preempted = [r for r in reqs if r.preemptions > 0]
+    assert preempted, "tight pool never triggered a preemption"
+    assert eng.metrics.counter("serving_requests_preempted").value == \
+        sum(r.preemptions for r in reqs)
+    assert reqs[0].preemptions == 0  # oldest is never the victim
+    for req, row in zip(reqs, want):
+        assert req.state == "finished" and req.error is None
+        check_parity(req, row)
+    assert eng.allocator.allocated_pages == 0  # no page leaked
+    # the lifecycle evidence landed on the timelines: the victim shows
+    # the swap-out, and page-by-page growth appears on some request
+    names = [e["name"]
+             for e in eng.request_trace(preempted[0].id)["events"]]
+    assert "preempted" in names
+    assert names.count("admitted") >= 1  # re-admission after the swap
+    all_events = [e["name"] for r in reqs
+                  for e in eng.request_trace(r.id)["events"]]
+    assert "page_grow" in all_events
+    snap = eng.serving_snapshot()
+    assert snap["requests_preempted"] >= 1
+    assert validate_serving_record(snap) == []
+
+
+def test_allocator_errors_are_real_exceptions():
+    """Double-free / foreign-page free / zero-size alloc raise
+    PageAllocatorError (an assert would vanish under ``python -O`` and
+    corrupt the free list silently)."""
+    from fleetx_tpu.serving.paged_cache import PageAllocatorError
+
+    a = PageAllocator(num_pages=6, page_size=4)
+    pages = a.alloc(2)
+    a.free(pages)
+    with pytest.raises(PageAllocatorError):
+        a.free(pages)                       # double-free
+    with pytest.raises(PageAllocatorError):
+        a.free([NULL_PAGE])                 # the null page is never out
+    with pytest.raises(PageAllocatorError):
+        a.alloc(0)                          # caller bug, not exhaustion
+    with pytest.raises(PageAllocatorError):
+        a.alloc(-3)
+    assert a.alloc(6) is None               # exhaustion stays None
+
+
+def test_allocator_conserves_pages_under_grow_free_preempt():
+    """Property drill over the lazy lifecycle's op mix: grants stay
+    disjoint, the null page never escapes, and free+held always equals
+    the pool — under random grow/free/preempt interleavings."""
+    rng = np.random.RandomState(0)
+    a = PageAllocator(num_pages=17, page_size=4)
+    held = []
+    for _ in range(500):
+        roll = rng.rand()
+        if roll < 0.55:
+            got = a.alloc(int(rng.randint(1, 4)))
+            if got is None and held:
+                # pool dry → "preempt": free a random victim's grant
+                a.free(held.pop(int(rng.randint(len(held)))))
+            elif got is not None:
+                held.append(got)
+        elif held:
+            a.free(held.pop(int(rng.randint(len(held)))))
+        out = [p for grant in held for p in grant]
+        assert len(out) == len(set(out)), "page granted twice"
+        assert NULL_PAGE not in out
+        assert a.allocated_pages == len(out)
+        assert a.free_pages + len(out) == a.usable_pages, "pages leaked"
+    for grant in held:
+        a.free(grant)
+    assert a.free_pages == a.usable_pages
+
+
+# ---------------------------------------------------------------------------
 # telemetry schema + perf gate wiring
 # ---------------------------------------------------------------------------
 
@@ -424,6 +661,21 @@ def test_perf_gate_serving_bands_skip_if_absent_and_catch_regression():
               if r["verdict"] == "FAIL"}
     assert "serving.tokens_per_s" in failed
     assert "serving.ttft_p99_s" in failed
+    # lazy-lifecycle bands (PR 18): occupancy regresses down, preemption
+    # rate up — direction-aware like the rest of SERVING_METRICS
+    assert perf_gate.SERVING_METRICS["serving.page_occupancy_mean"][0] == \
+        "higher"
+    assert perf_gate.SERVING_METRICS["serving.preemption_rate"][0] == \
+        "lower"
+    lz = dict(base, serving={"page_occupancy_mean": 0.6,
+                             "preemption_rate": 0.05})
+    drift = json.loads(json.dumps(lz))
+    drift["serving"]["page_occupancy_mean"] = 0.4
+    drift["serving"]["preemption_rate"] = 0.4
+    failed = {r["metric"] for r in perf_gate.compare(drift, lz)
+              if r["verdict"] == "FAIL"}
+    assert "serving.page_occupancy_mean" in failed
+    assert "serving.preemption_rate" in failed
 
 
 def test_inference_predict_fetches_output_tree_in_one_device_get(

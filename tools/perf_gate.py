@@ -104,6 +104,15 @@ SERVING_METRICS = {
     "serving.requests_per_chip": ("higher", 0.15, 0.0),
     "serving.page_occupancy": ("higher", 0.15, 0.05),
     "serving.slo_attainment": ("higher", 0.0, 0.02),
+    # lazy-lifecycle rows (PR 18): MEAN occupancy over worked steps is
+    # the production-occupancy headline — lazy admission exists to raise
+    # it, so it regresses DOWN (absolute floor over tiny-bench noise);
+    # preemption_rate (swap-outs per completion) regresses UP on a pure
+    # absolute band — a modest rate is healthy back-pressure, but a jump
+    # of 0.25 preemptions/request means admission got too greedy for the
+    # pool and decode is thrashing
+    "serving.page_occupancy_mean": ("higher", 0.15, 0.05),
+    "serving.preemption_rate": ("lower", 0.0, 0.25),
 }
 
 
@@ -297,6 +306,24 @@ def self_check(baseline_entry: dict) -> list[str]:
     drifted_sv["serving"]["slo_attainment"] = 0.90
     rows = compare(drifted_sv, sv)
     for metric in ("serving.requests_per_chip", "serving.slo_attainment"):
+        if not any(r["metric"] == metric and r["verdict"] == "FAIL"
+                   for r in rows):
+            problems.append(f"synthetic {metric} regression NOT caught")
+    # lazy-lifecycle serving rows (their real rows skip-if-absent on
+    # pre-lazy baselines): identical copies pass, a mean-occupancy
+    # collapse (the batcher stopped packing) and a preemption-rate jump
+    # past the 0.25/request band (admission thrashing) must both fail
+    lz = dict(baseline_entry)
+    lz["serving"] = {"page_occupancy_mean": 0.7, "preemption_rate": 0.1}
+    rows = compare(json.loads(json.dumps(lz)), lz)
+    if any(r["verdict"] == "FAIL" for r in rows):
+        problems.append("identical lazy-lifecycle rows flagged as regression")
+    drifted_lz = json.loads(json.dumps(lz))
+    drifted_lz["serving"]["page_occupancy_mean"] = 0.45
+    drifted_lz["serving"]["preemption_rate"] = 0.5
+    rows = compare(drifted_lz, lz)
+    for metric in ("serving.page_occupancy_mean",
+                   "serving.preemption_rate"):
         if not any(r["metric"] == metric and r["verdict"] == "FAIL"
                    for r in rows):
             problems.append(f"synthetic {metric} regression NOT caught")

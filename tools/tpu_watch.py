@@ -215,12 +215,13 @@ def _capture_gpt_seq2048(state: dict) -> None:
 _TUNNEL_DEAD = ("timeout", "UNAVAILABLE", "DEADLINE_EXCEEDED")
 
 
-def _bench_sweep(state: dict, key: str, variants, script: str = "bench.py",
+def _bench_sweep(state: dict, key: str, variants, script="bench.py",
                  first_success: bool = False) -> None:
     """Run ``script`` once per ``(suffix, env, annotate)`` variant and keep
     the fastest healthy result in ``state[key]`` (or the first healthy one
     with ``first_success`` — for fallback chains like bs16→bs8 where a
-    success ends the hunt).
+    success ends the hunt). ``script`` is a path, or a full argv tail for
+    entry points that need flags (``["tools/serve.py", "--bench", ...]``).
 
     A tunnel-dead error class aborts the sweep (the window is gone —
     retry next window); a sweep where every attempt failed for any other
@@ -229,8 +230,9 @@ def _bench_sweep(state: dict, key: str, variants, script: str = "bench.py",
     burn every future healthy window (the bs32 lesson)."""
     best = None
     aborted = False
+    tail = list(script) if isinstance(script, (list, tuple)) else [script]
     for suffix, env, annotate in variants:
-        res, err = run_child(f"{key}{suffix}", [sys.executable, script], env)
+        res, err = run_child(f"{key}{suffix}", [sys.executable] + tail, env)
         if res and res.get("device_kind") != "cpu":
             res.update(annotate)
             if best is None or res["value"] > best["value"]:
@@ -282,7 +284,8 @@ def _capture_gpt_bs32_vc(state: dict) -> None:
             log("gpt_bs32_vc: repeated OOM; marking skipped")
 
 
-def _traced_sweep(state: dict, key: str, variants) -> None:
+def _traced_sweep(state: dict, key: str, variants,
+                  script="bench.py") -> None:
     """``_bench_sweep`` plus ONE traced re-run of the winning variant.
 
     The PR-10 mechanized decomposition (docs/performance.md). The timing
@@ -301,14 +304,15 @@ def _traced_sweep(state: dict, key: str, variants) -> None:
 
     wrapped = [(suffix, env, {**annotate, "_env": dict(env)})
                for suffix, env, annotate in variants]
-    _bench_sweep(state, key, wrapped)
+    _bench_sweep(state, key, wrapped, script=script)
     res = state.get(key)
     env = res.pop("_env", None) if isinstance(res, dict) else None
     if not env or "skipped" in res:
         return
     trace_dir = os.path.join(ART, f"trace_{key}")
     shutil.rmtree(trace_dir, ignore_errors=True)
-    tres, err = run_child(f"{key}_trace", [sys.executable, "bench.py"],
+    tail = list(script) if isinstance(script, (list, tuple)) else [script]
+    tres, err = run_child(f"{key}_trace", [sys.executable] + tail,
                           {**env, "FLEETX_BENCH_TRACE": trace_dir})
     if tres and tres.get("device_kind") != "cpu":
         # the traced tokens/s is recorded for the overhead audit but the
@@ -490,6 +494,30 @@ def _capture_gpt_fusedbwd(state: dict) -> None:
                     {"flash_fused_bwd": False})])
 
 
+_SERVING_CFG = os.path.join("fleetx_tpu", "configs", "nlp", "gpt",
+                            "serving_gpt_345M.yaml")
+
+
+def _capture_gpt_paged_kernel(state: dict) -> None:
+    """In-kernel paged attention A/B (docs/serving.md): the Poisson
+    serving bench (tools/serve.py --bench) with FLEETX_BENCH_PAGED_KERNEL
+    forcing each decode path — the Pallas kernel streams pages through
+    VMEM via scalar-prefetched block tables, the gather fallback
+    materializes the [B, pages*page_size] KV view in HBM every step. The
+    untraced sweep keeps the faster side (expected: kernel, by the
+    avoided gather traffic); the winner's traced re-run tars the profiler
+    window so the HBM-read claim is auditable from the artifact. The
+    bench JSON's serving block carries page_occupancy_mean /
+    preemption_rate for the perf_gate lazy-lifecycle bands."""
+    _traced_sweep(
+        state, "gpt_paged_kernel",
+        [("_kernel", {"FLEETX_BENCH_PAGED_KERNEL": "1"},
+          {"decode_path": "paged_kernel"}),
+         ("_gather", {"FLEETX_BENCH_PAGED_KERNEL": "0"},
+          {"decode_path": "gather"})],
+        script=["tools/serve.py", "--bench", "-c", _SERVING_CFG])
+
+
 CAPTURES = [
     ("gpt", _capture_gpt),
     ("gpt_trace", _capture_gpt_trace),
@@ -509,6 +537,7 @@ CAPTURES = [
     ("gpt_bf16res", _capture_gpt_bf16res),
     ("gpt_zero2", _capture_gpt_zero2),
     ("gpt_fusedbwd", _capture_gpt_fusedbwd),
+    ("gpt_paged_kernel", _capture_gpt_paged_kernel),
 ]
 
 
