@@ -85,6 +85,9 @@ SERVING_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     # the Pallas kernel's support predicates admitted the config/mesh,
     # "gather" for the dense fallback)
     "requests_preempted": ((int,), False),
+    # deadline plane (docs/serving.md "Fault tolerance"): in-flight
+    # requests shed at a decode tick because their deadline expired
+    "deadline_sheds": ((int,), False),
     "decode_path": ((str,), False),
     "queue_depth": (_NULLABLE_INT, True),
     "active_requests": (_NULLABLE_INT, True),
@@ -143,6 +146,8 @@ FLEET_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "itl_p99_s": (_NULLABLE_NUM, False),
     "itl_p99_replica": ((str,), False),
     "slo_attainment": (_NULLABLE_NUM, False),
+    # fleet-summed deadline sheds (docs/serving.md "Fault tolerance")
+    "deadline_sheds": ((int,), False),
     # router-side dispatch counters (serving/router.py)
     "dispatched_total": ((int,), False),
     "redispatched_total": ((int,), False),
@@ -150,6 +155,14 @@ FLEET_RECORD_SCHEMA: dict[str, tuple[tuple, bool]] = {
     "drain_refusals_total": ((int,), False),
     "no_backend_total": ((int,), False),
     "completed_total": ((int,), False),
+    # breaker/hedging counters + the per-backend breaker-state map
+    # ("host:port" → closed|open|half_open) — the chaos drill reads the
+    # open→half_open→closed walk off the fleet record stream
+    "breaker_opens_total": ((int,), False),
+    "breaker_closes_total": ((int,), False),
+    "hedges_total": ((int,), False),
+    "hedge_cancels_total": ((int,), False),
+    "breakers": ((dict,), False),
 }
 
 #: registry metric names the serving runtime owns (docs/observability.md):
@@ -160,6 +173,10 @@ SERVING_METRIC_NAMES = (
     "serving_page_occupancy", "serving_kv_fragmentation",
     "serving_requests_total", "serving_requests_completed",
     "serving_requests_refused", "serving_tokens_total",
+    # deadline-admission plane (docs/serving.md "Fault tolerance"):
+    # classified refusals + in-flight sheds at decode-tick boundaries
+    "serving_deadline_sheds", "serving_refusals_overloaded",
+    "serving_refusals_unmeetable",
 )
 
 #: registry names the SLO layer owns (observability/slo.py) — per-target

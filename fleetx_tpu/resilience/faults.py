@@ -36,6 +36,21 @@ nan_loss_at=4:5"``), env winning — so a restart harness can inject into an
 unmodified recipe. A module-level active plan lets deep layers
 (``core/checkpoint.py``) consult injection points without config plumbing.
 
+The serving chaos drills (docs/serving.md "Fault tolerance") add three
+replica-front failure shapes, consumed by ``serving/server.py``:
+
+- ``slow_decode_ms_at: [K, MS]`` — from work-step K onward every decode
+  step takes MS extra milliseconds (a straggler replica; the router's
+  hedged dispatch must absorb the tail);
+- ``blackhole_after: K``     — after K responses the replica still
+  ACCEPTS connections but never answers anything again, verbs included
+  (a hung process; only an observing health probe, not a timer, can
+  tell it from a busy one);
+- ``crash_mid_write: K``     — the K-th data response is torn mid-JSON
+  and the process hard-exits (a crash that leaves a half-written line
+  on the wire; the router must classify it as transport failure and
+  re-dispatch).
+
 Multi-host gangs add ``only_rank: R``: the plan arms on process R alone
 and every other rank gets an empty plan from the same config — the drill a
 collective recovery needs is "ONE rank fails, the whole gang reacts"
@@ -47,6 +62,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 from typing import Any, Optional
 
 import numpy as np
@@ -102,7 +118,10 @@ class FaultPlan:
                  ckpt_write_fail_times: int = 0,
                  bitflip_param_at: Optional[int] = None,
                  corrupt_ckpt_at: Optional[int] = None,
-                 corrupt_restore_at: Optional[int] = None):
+                 corrupt_restore_at: Optional[int] = None,
+                 slow_decode_ms_at: Optional[list] = None,
+                 blackhole_after: Optional[int] = None,
+                 crash_mid_write: Optional[int] = None):
         self.data_raise_at = data_raise_at
         self.nan_loss_at = set(int(s) for s in (nan_loss_at or ()))
         self.sigterm_at = sigterm_at
@@ -110,6 +129,19 @@ class FaultPlan:
         self.bitflip_param_at = bitflip_param_at
         self.corrupt_ckpt_at = corrupt_ckpt_at
         self.corrupt_restore_at = corrupt_restore_at
+        if slow_decode_ms_at is not None:
+            pair = [int(v) for v in slow_decode_ms_at]
+            assert len(pair) == 2, \
+                "slow_decode_ms_at wants [work_step, extra_ms]"
+            slow_decode_ms_at = pair
+        self.slow_decode_ms_at = slow_decode_ms_at
+        self.blackhole_after = blackhole_after
+        self.crash_mid_write = crash_mid_write
+        # serving-front counters are bumped by concurrent connection
+        # handler threads (unlike the train-loop triggers above, which
+        # are engine-thread-only), so they share one lock
+        self._io_lock = threading.Lock()
+        self._responses = 0
 
     @classmethod
     def from_cfg(cls, cfg: Optional[dict],
@@ -137,6 +169,9 @@ class FaultPlan:
         def opt_int(key: str) -> Optional[int]:
             return None if merged.get(key) is None else int(merged[key])
 
+        slow = merged.get("slow_decode_ms_at")
+        if isinstance(slow, int):
+            slow = [slow]
         return cls(
             data_raise_at=opt_int("data_raise_at"),
             nan_loss_at=nan_at,
@@ -145,7 +180,10 @@ class FaultPlan:
                                       or 0),
             bitflip_param_at=opt_int("bitflip_param_at"),
             corrupt_ckpt_at=opt_int("corrupt_ckpt_at"),
-            corrupt_restore_at=opt_int("corrupt_restore_at"))
+            corrupt_restore_at=opt_int("corrupt_restore_at"),
+            slow_decode_ms_at=slow,
+            blackhole_after=opt_int("blackhole_after"),
+            crash_mid_write=opt_int("crash_mid_write"))
 
     @property
     def armed(self) -> bool:
@@ -155,7 +193,10 @@ class FaultPlan:
                     or self.ckpt_write_fail_times
                     or self.bitflip_param_at is not None
                     or self.corrupt_ckpt_at is not None
-                    or self.corrupt_restore_at is not None)
+                    or self.corrupt_restore_at is not None
+                    or self.slow_decode_ms_at is not None
+                    or self.blackhole_after is not None
+                    or self.crash_mid_write is not None)
 
     # ------------------------------------------------------------- triggers
     def on_batch(self, index: int, batch: Any) -> Any:
@@ -198,6 +239,38 @@ class FaultPlan:
             self.bitflip_param_at = None
             return True
         return False
+
+    # ----------------------------------------------------- serving triggers
+    def decode_delay_s(self, work_step: int) -> float:
+        """Extra seconds the replica loop must sleep after ``work_step``
+        (0.0 while the straggler fault is unarmed or not yet due)."""
+        if self.slow_decode_ms_at is None:
+            return 0.0
+        at, ms = self.slow_decode_ms_at
+        return ms / 1000.0 if work_step >= at else 0.0
+
+    def blackholed(self) -> bool:
+        """True once the replica has answered its ``blackhole_after``-th
+        response: from then on every connection — data or verb — is
+        accepted and never answered (the hung-process shape)."""
+        if self.blackhole_after is None:
+            return False
+        with self._io_lock:
+            return self._responses >= self.blackhole_after
+
+    def note_response(self) -> None:
+        """Count one answered data response (drives ``blackhole_after``
+        and ``crash_mid_write``)."""
+        with self._io_lock:
+            self._responses += 1
+
+    def take_crash_mid_write(self) -> bool:
+        """True when the NEXT data response is the ``crash_mid_write``-th:
+        the caller writes a torn line and hard-exits."""
+        if self.crash_mid_write is None:
+            return False
+        with self._io_lock:
+            return self._responses + 1 >= self.crash_mid_write
 
     def fire(self, point: str) -> None:
         """Named-point hook for deep layers (``"ckpt_write"``)."""

@@ -162,6 +162,14 @@ def run_serving_bench(engine: ServingEngine, *, n_requests: int = 32,
             "decode_path": snap.get("decode_path", "gather"),
             "requests_per_chip": round(
                 len(completed) / max(engine.n_chips, 1), 3),
+            # fault-tolerance rows (tools/perf_gate.py bands): deadline
+            # sheds come straight off the engine snapshot; the in-process
+            # bench has no router, so hedges/breaker opens are honest
+            # zeros — the gate's abs band then catches any future bench
+            # wiring that starts opening breakers under clean load
+            "deadline_sheds": int(snap.get("deadline_sheds") or 0),
+            "hedges_total": 0,
+            "breaker_opens": 0,
         },
     }
     if snap.get("slo_attainment") is not None:

@@ -108,6 +108,15 @@ class ServingConfig:
     # declarative SLO targets (observability/slo.py) — the ``Serving.slo``
     # YAML block; None disables SLO evaluation entirely
     slo: Optional[dict] = None
+    # admission-queue bound (docs/serving.md "Fault tolerance"): submissions
+    # past this many waiting requests are refused ``overloaded`` with a
+    # ``retry_after_s`` hint instead of queueing unboundedly; 0 = unbounded
+    max_queue: int = 256
+    # router behaviour block (``Serving.router``) — consumed by
+    # ``serving/router.py``, validated eagerly in ``process_serving_config``
+    # and forwarded by ``tools/serve.py --router``; the engine itself
+    # never reads it
+    router: Optional[dict] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "ServingConfig":
@@ -141,6 +150,12 @@ class ServingRequest:
     # the preemption policy's youngest-first ordering key
     admit_seq: int = -1
     preemptions: int = 0
+    # client deadline (seconds from submission); None = no deadline. An
+    # admission-time refusal classifies it (``overloaded``/``unmeetable``)
+    # and fills ``retry_after_s``; an in-flight expiry sheds the request
+    # at the next decode-tick boundary (``deadline_shed``)
+    deadline_s: Optional[float] = None
+    retry_after_s: Optional[float] = None
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -158,7 +173,7 @@ class ServingRequest:
 #: (the request loops back to ``admitted`` afterwards)
 TIMELINE_EVENTS = ("queued", "admitted", "prefill_chunk", "first_token",
                    "decode_tick", "page_grow", "preempted", "finished",
-                   "refused", "drain")
+                   "refused", "drain", "deadline_shed")
 
 #: milestone events whose first timestamp is pinned outside the ring so
 #: attribution survives decode-tick eviction on long generations
@@ -375,16 +390,25 @@ class ServingEngine:
     # ------------------------------------------------------------ submission
     def submit(self, prompt: list, max_new_tokens: int,
                request_id: Optional[str] = None,
-               callback: Optional[Callable] = None) -> ServingRequest:
-        """Queue one request; refusals (drain / permanent OOM) come back
-        with ``state == REFUSED`` and ``error`` set, never queued."""
+               callback: Optional[Callable] = None,
+               deadline_s: Optional[float] = None) -> ServingRequest:
+        """Queue one request; refusals (drain / permanent OOM / deadline)
+        come back with ``state == REFUSED`` and ``error`` set, never
+        queued. ``deadline_s`` makes admission deadline-aware: a request
+        whose projected completion exceeds its deadline is refused up
+        front — ``unmeetable`` (its own service time alone blows the
+        deadline; retrying won't help until the deadline grows) or
+        ``overloaded`` (the queue ahead of it does; ``retry_after_s``
+        names the projected drain)."""
         tsan.note_access(self, "submit")
         rid = request_id if request_id is not None \
             else f"req{self._rid_counter}"
         self._rid_counter += 1
         req = ServingRequest(id=str(rid), prompt=[int(t) for t in prompt],
                              max_new_tokens=int(max_new_tokens),
-                             callback=callback, submitted_at=time.monotonic())
+                             callback=callback, submitted_at=time.monotonic(),
+                             deadline_s=(float(deadline_s)
+                                         if deadline_s is not None else None))
         self.metrics.counter("serving_requests_total").inc()
         self.timelines.open(req.id).note(
             "queued", prompt_len=len(req.prompt),
@@ -400,10 +424,70 @@ class ServingEngine:
                      f"{need_tokens} tokens; pool holds "
                      f"{self.allocator.usable_pages} pages of "
                      f"{self.allocator.page_size}")
+        max_queue = int(self.serving.max_queue or 0)
+        if max_queue and len(self._waiting) >= max_queue:
+            service, eta = self.projected_completion_s(
+                len(req.prompt), req.max_new_tokens)
+            req.retry_after_s = round(max(
+                (eta or 0.0) - (service or 0.0), 0.05), 3)
+            self.metrics.counter("serving_refusals_overloaded").inc()
+            return self._refuse(
+                req, f"overloaded: admission queue full "
+                     f"({len(self._waiting)} >= {max_queue})")
+        if req.deadline_s is not None:
+            service, eta = self.projected_completion_s(
+                len(req.prompt), req.max_new_tokens)
+            if service is not None and service > req.deadline_s:
+                req.retry_after_s = round(service, 3)
+                self.metrics.counter("serving_refusals_unmeetable").inc()
+                return self._refuse(
+                    req, f"unmeetable: projected service {service:.3f}s "
+                         f"exceeds deadline {req.deadline_s:.3f}s")
+            if eta is not None and eta > req.deadline_s:
+                req.retry_after_s = round(eta - service, 3)
+                self.metrics.counter("serving_refusals_overloaded").inc()
+                return self._refuse(
+                    req, f"overloaded: projected completion {eta:.3f}s "
+                         f"(queue {len(self._waiting)}) exceeds deadline "
+                         f"{req.deadline_s:.3f}s")
         self._waiting.append(req)
         flight.note("serving", "submit", id=req.id,
                     prompt_len=len(req.prompt))
         return req
+
+    def _measured_mean(self, name: str) -> Optional[float]:
+        """Mean of a registry histogram, None before any observation."""
+        h = self.metrics.histogram(name)
+        count = int(getattr(h, "total_count", 0) or 0)
+        if count <= 0:
+            return None
+        return float(h.total_sum) / count
+
+    def projected_completion_s(self, prompt_len: int, max_new: int):
+        """``(service_s, eta_s)`` estimate for a fresh submission.
+
+        ``service_s`` is the request's own cost — prefill chunks at the
+        measured mean ``serving_prefill_step`` plus ``max_new`` tokens at
+        the measured mean inter-token latency. ``eta_s`` adds the queue
+        ahead of it: every waiting/prefilling request's own service
+        estimate, divided by the decode batch width (decode is batched,
+        so queued work drains ``max_batch``-wide, not serially). Both are
+        None until the engine has measured at least one prefill chunk and
+        one decode tick — admission never refuses on guesswork."""
+        pf = self._measured_mean("serving_prefill_step")
+        itl = self._measured_mean("serving_inter_token")
+        if pf is None or itl is None:
+            return None, None
+        chunk = max(int(self.serving.prefill_chunk), 1)
+
+        def est(plen: int, new: int) -> float:
+            return -(-plen // chunk) * pf + new * itl
+
+        service = est(max(int(prompt_len), 1), max(int(max_new), 1))
+        ahead = sum(est(max(len(r.prompt), 1), max(r.max_new_tokens, 1))
+                    for r in list(self._waiting) + list(self._prefilling))
+        eta = service + ahead / max(int(self.serving.max_batch), 1)
+        return service, eta
 
     def _refuse(self, req: ServingRequest, why: str) -> ServingRequest:
         req.state, req.error = REFUSED, why
@@ -567,8 +651,96 @@ class ServingEngine:
         flight.note("serving", "preempt", id=req.id,
                     pages_freed=pages_freed)
 
+    def _shed_expired(self) -> None:
+        """Drop every request whose deadline already passed — queued OR
+        in-flight — at the decode-tick boundary (the only point where a
+        slot can be reclaimed without tearing a step in half). Sheds are
+        classified refusals: the caller gets an error response, never
+        silence, and the ``serving_deadline_sheds`` counter + the
+        ``deadline_shed`` timeline event make every one attributable."""
+        now = time.monotonic()
+
+        def expired(r: ServingRequest) -> bool:
+            return r.deadline_s is not None and \
+                now - r.submitted_at > r.deadline_s
+
+        for req in [r for r in self._waiting if expired(r)]:
+            self._waiting.remove(req)
+            self._shed(req, now)
+        for req in list(self._slots):
+            if req is not None and req.state in (PREFILL, RUNNING) \
+                    and expired(req):
+                self._shed(req, now)
+
+    def _release_slot(self, req: ServingRequest) -> None:
+        """Free any slot/pages ``req`` holds (shed/cancel teardown)."""
+        if req.slot >= 0:
+            self.allocator.free(req.pages)
+            slot = req.slot
+            self._slots[slot] = None
+            self._block_tables[slot] = NULL_PAGE
+            self._lens[slot] = -1
+            self._last_tokens[slot] = 0
+            if req in self._prefilling:
+                self._prefilling.remove(req)
+        req.slot, req.pages = -1, []
+
+    def _shed(self, req: ServingRequest, now: float) -> None:
+        """Refuse one expired request, freeing any slot/pages it holds."""
+        tsan.note_access(self, "shed")
+        age = now - req.submitted_at
+        self._release_slot(req)
+        req.state = REFUSED
+        req.error = (f"deadline_shed: expired {age:.3f}s into a "
+                     f"{req.deadline_s:.3f}s deadline")
+        req.finished_at = now
+        self.metrics.counter("serving_deadline_sheds").inc()
+        self.metrics.counter("serving_requests_refused").inc()
+        tl = self.timelines.get(req.id)
+        if tl is not None:
+            tl.note("deadline_shed", age_s=round(age, 4),
+                    deadline_s=req.deadline_s,
+                    tokens_dropped=len(req.tokens))
+            tl.state = "refused"
+        flight.note("serving", "deadline_shed", id=req.id,
+                    age_s=round(age, 4), deadline_s=req.deadline_s)
+        if req.callback:
+            req.callback(req)
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel one queued or in-flight request (the ``cancel`` verb —
+        hedged dispatch tears down the losing replica's copy with this).
+        Runs on the engine thread via the server's control queue, so the
+        teardown lands at a step boundary like every other slot
+        transition. Returns False when the id is unknown, already
+        finished, or already refused."""
+        tsan.note_access(self, "cancel")
+        rid = str(request_id)
+        req = next((r for r in self._waiting if r.id == rid), None)
+        if req is not None:
+            self._waiting.remove(req)
+        else:
+            req = next((r for r in self._slots
+                        if r is not None and r.id == rid
+                        and r.state in (PREFILL, RUNNING)), None)
+        if req is None:
+            return False
+        self._release_slot(req)
+        req.state, req.error = REFUSED, "cancelled"
+        req.finished_at = time.monotonic()
+        self.metrics.counter("serving_requests_refused").inc()
+        tl = self.timelines.get(req.id)
+        if tl is not None:
+            tl.note("refused", why="cancelled")
+            tl.state = "refused"
+        flight.note("serving", "cancel", id=req.id)
+        if req.callback:
+            req.callback(req)
+        return True
+
     def _decode_step(self) -> bool:
         """One token for every RUNNING slot (static batch; masked rows)."""
+        self._shed_expired()
         if self.serving.lazy_alloc:
             self._grow_or_preempt()
         running = [r for r in self._slots
@@ -692,7 +864,9 @@ class ServingEngine:
         time never pollutes tokens/s or the latency quantiles."""
         for name in ("serving_requests_total", "serving_requests_completed",
                      "serving_requests_refused", "serving_requests_preempted",
-                     "serving_tokens_total"):
+                     "serving_tokens_total", "serving_deadline_sheds",
+                     "serving_refusals_overloaded",
+                     "serving_refusals_unmeetable"):
             self.metrics.counter(name).reset()
         for name in ("serving_ttft", "serving_inter_token",
                      "serving_prefill_step", "serving_decode_step"):
@@ -755,6 +929,8 @@ class ServingEngine:
                 m.counter("serving_requests_refused").value),
             "requests_preempted": int(
                 m.counter("serving_requests_preempted").value),
+            "deadline_sheds": int(
+                m.counter("serving_deadline_sheds").value),
             "decode_path": ("paged_kernel" if self.paged_kernel_active
                             else "gather"),
             **gauges,

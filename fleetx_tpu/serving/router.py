@@ -13,6 +13,22 @@ Placement policy: **least-outstanding** with round-robin tie-break — the
 cheapest estimator of per-replica queue depth that needs no backend
 cooperation (each replica already exports its own queue gauges).
 
+Health policy (docs/serving.md "Fault tolerance"): each backend carries a
+**circuit breaker** instead of a flat penalty timer. ``closed`` serves
+normally; a transport failure, torn response, hung probe or drain refusal
+opens it (``breaker_opens_total``); an ``open`` backend takes no traffic
+until a background health probe (the cheap ``ping`` verb, plus the
+``stats`` sweep when a fleet sink runs) OBSERVES it answering again —
+recovery is observed, never assumed from a timer — which half-opens it;
+``half_open`` admits exactly ONE trial request, whose success closes the
+breaker (``breaker_closes_total``) and whose failure re-opens it.
+Dispatch carries a per-request retry budget with jittered exponential
+backoff (``resilience/policy.py``), and **hedged dispatch**: after
+``hedge_ms`` of silence from the chosen replica the same request races a
+second one, the first complete answer wins, and the loser is torn down
+through the ``cancel`` verb — decode is idempotent, so hedging is
+loss-free and buys back the straggler tail.
+
 The router is also the fleet's observer (docs/serving.md
 "Observability"): it counts dispatches / re-dispatches / penalties /
 drain refusals, keeps a bounded per-request dispatch journal, and — when
@@ -35,7 +51,9 @@ imports it does take (schema, sinks) are stdlib-only.
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import queue
 import socket
 import threading
 import time
@@ -43,29 +61,70 @@ from collections import OrderedDict, deque
 from typing import Dict, Optional
 
 from fleetx_tpu.observability import tsan
-
-#: seconds a failed/draining backend is skipped before being retried
-#: (a supervisor restart needs a few seconds to bring the replica back)
-PENALTY_S = 1.0
-
-#: total seconds the router keeps retrying one accepted request before
-#: answering "no backend" — covers a full supervisor restart cycle
-DISPATCH_DEADLINE_S = 120.0
+from fleetx_tpu.resilience.policy import RetryPolicy
 
 #: seconds between fleet stats sweeps when a fleet sink is configured
 DEFAULT_POLL_INTERVAL_S = 1.0
 
-#: timeout for one stats/trace side-channel round trip (read-only verbs
-#: answered at a step boundary — far faster than a generate request)
-VERB_TIMEOUT_S = 10.0
-
 #: fleet records carry the same version as serving snapshots
 FLEET_SCHEMA_VERSION = 2
+
+#: breaker states (docs/serving.md "Fault tolerance")
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
 #: router-owned dispatch counters, merged into every fleet record
 ROUTER_COUNTERS = ("dispatched_total", "redispatched_total",
                    "penalties_total", "drain_refusals_total",
-                   "no_backend_total", "completed_total")
+                   "no_backend_total", "completed_total",
+                   "breaker_opens_total", "breaker_closes_total",
+                   "hedges_total", "hedge_cancels_total")
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """The ``Serving.router`` YAML block — every knob that used to be a
+    module constant, eagerly validated in ``process_serving_config`` and
+    forwarded by ``tools/serve.py --router`` (docs/serving.md "Fault
+    tolerance")."""
+
+    #: minimum seconds an opened breaker holds before probes may test the
+    #: backend again (a supervisor restart needs a moment to rebind)
+    penalty_s: float = 1.0
+    #: total seconds one accepted request is retried before "no backend"
+    dispatch_deadline_s: float = 120.0
+    #: timeout for one ping/stats/trace/cancel side-channel round trip
+    verb_timeout_s: float = 10.0
+    #: per-forward data-request timeout (covers replica queue time)
+    request_timeout_s: float = 120.0
+    #: milliseconds of primary silence before a hedge fires; 0 disables
+    hedge_ms: float = 250.0
+    #: dispatch attempts one request may consume across backends
+    retry_budget: int = 8
+    #: seconds between background health-probe sweeps
+    probe_interval_s: float = 0.25
+    #: consecutive failures that open a closed breaker
+    breaker_threshold: int = 1
+
+    def __post_init__(self):
+        for key in ("penalty_s", "dispatch_deadline_s", "verb_timeout_s",
+                    "request_timeout_s", "probe_interval_s"):
+            assert float(getattr(self, key)) > 0, \
+                f"Serving.router.{key} must be > 0"
+        assert float(self.hedge_ms) >= 0, \
+            "Serving.router.hedge_ms must be >= 0 (0 disables hedging)"
+        for key in ("retry_budget", "breaker_threshold"):
+            assert int(getattr(self, key)) >= 1, \
+                f"Serving.router.{key} must be >= 1"
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "RouterConfig":
+        """Build from the YAML block (unknown keys rejected eagerly)."""
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        assert not unknown, \
+            f"unknown Serving.router keys: {sorted(unknown)}"
+        return cls(**{k: v for k, v in d.items() if v is not None})
 
 
 def _read_line(conn: socket.socket) -> bytes:
@@ -82,23 +141,32 @@ def _read_line(conn: socket.socket) -> bytes:
 
 
 class Backend:
-    """One replica address + its health/placement bookkeeping."""
+    """One replica address + its breaker/placement bookkeeping.
+
+    All mutable fields are guarded by the router's placement lock
+    (``tsan.lock("router.placement")``) — handler threads, the hedge
+    racers and the probe loop all touch them."""
 
     def __init__(self, host: str, port: int):
         self.addr = (host, int(port))
         self.outstanding = 0
-        self.penalized_until = 0.0
         self.dispatched = 0
         self.failures = 0
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        # half-open admits exactly ONE in-flight trial request; the flag
+        # is set by pick() under the placement lock, so two handler
+        # threads racing the same recovering backend cannot both get it
+        self.trial_in_flight = False
 
-    def available(self, now: float) -> bool:
+    def can_accept(self) -> bool:
         """Whether placement may pick this backend right now."""
-        return now >= self.penalized_until
-
-    def penalize(self, now: float, seconds: float = PENALTY_S) -> None:
-        """Skip this backend for ``seconds`` (crash or drain observed)."""
-        self.penalized_until = now + seconds
-        self.failures += 1
+        if self.state == CLOSED:
+            return True
+        if self.state == HALF_OPEN:
+            return not self.trial_in_flight
+        return False
 
 
 def _addr_str(addr: tuple) -> str:
@@ -145,7 +213,8 @@ class RequestJournal:
 
 
 def merge_fleet_snapshots(snaps: Dict[str, dict], replicas_total: int,
-                          router_counters: Optional[dict] = None) -> dict:
+                          router_counters: Optional[dict] = None,
+                          breakers: Optional[dict] = None) -> dict:
     """N per-replica ``serving_snapshot()`` dicts → one fleet record.
 
     The serving-side twin of ``observability/gang.py:_merge_window``:
@@ -179,6 +248,7 @@ def merge_fleet_snapshots(snaps: Dict[str, dict], replicas_total: int,
         "requests_admitted": _sum_int("requests_admitted"),
         "requests_completed": _sum_int("requests_completed"),
         "requests_refused": _sum_int("requests_refused"),
+        "deadline_sheds": _sum_int("deadline_sheds"),
         "tokens_total": _sum_int("tokens_total"),
         "tokens_per_sec": sum(_present("tokens_per_sec").values())
         if replicas else None,
@@ -216,21 +286,30 @@ def merge_fleet_snapshots(snaps: Dict[str, dict], replicas_total: int,
     for name in ROUTER_COUNTERS:
         if router_counters and name in router_counters:
             record[name] = int(router_counters[name])
+    if breakers:
+        # per-backend breaker states: the drill reads the
+        # open→half_open→closed walk straight off the record stream
+        record["breakers"] = {str(a): str(s) for a, s in breakers.items()}
     return record
 
 
 class Router:
-    """Round-robin + least-outstanding front over the replica fleet."""
+    """Breaker-gated least-outstanding front over the replica fleet."""
 
     def __init__(self, backends: list, host: str = "127.0.0.1",
-                 port: int = 0, request_timeout: float = 120.0,
+                 port: int = 0, request_timeout: Optional[float] = None,
                  fleet_out: Optional[str] = None,
-                 poll_interval: float = DEFAULT_POLL_INTERVAL_S):
+                 poll_interval: float = DEFAULT_POLL_INTERVAL_S,
+                 config: Optional[RouterConfig] = None):
+        self.cfg = config or RouterConfig()
+        if request_timeout is not None:  # legacy kwarg wins over the block
+            self.cfg = dataclasses.replace(
+                self.cfg, request_timeout_s=float(request_timeout))
+        self.request_timeout = float(self.cfg.request_timeout_s)
         self.backends = [Backend(h, p) for h, p in backends]
         assert self.backends, "router needs at least one backend"
         self.host = host
         self.port = int(port)
-        self.request_timeout = float(request_timeout)
         self.fleet_out = fleet_out
         self.poll_interval = float(poll_interval)
         self._rr = 0
@@ -242,6 +321,13 @@ class Router:
         self.journal = RequestJournal()
         self.last_fleet: Optional[dict] = None
         self._fleet_sink = None
+        # the all-breakers-open wait: jittered exponential backoff
+        # (resilience/policy.py) in place of the old fixed 50 ms spin —
+        # a thundering herd of handler threads de-synchronises instead of
+        # hammering pick() in lockstep
+        self._spin = RetryPolicy(max_attempts=1_000_000, backoff_s=0.02,
+                                 max_backoff_s=max(self.cfg.penalty_s, 0.1),
+                                 jitter=0.5)
 
     def _count(self, name: str) -> None:
         with self._lock:
@@ -252,13 +338,20 @@ class Router:
         with self._lock:
             return dict(self.counters)
 
-    # ------------------------------------------------------------ placement
-    def pick(self) -> Optional[Backend]:
-        """Least outstanding among available backends, round-robin ties;
-        None when every backend is inside its penalty window."""
-        now = time.monotonic()
+    def breaker_states(self) -> dict:
+        """``addr → closed|open|half_open`` snapshot (fleet records)."""
         with self._lock:
-            avail = [b for b in self.backends if b.available(now)]
+            return {_addr_str(b.addr): b.state for b in self.backends}
+
+    # ------------------------------------------------------------ placement
+    def pick(self, exclude: tuple = ()) -> Optional[Backend]:
+        """Least outstanding among accepting backends, round-robin ties;
+        None when every breaker is open (or holds an in-flight trial).
+        A half-open choice takes its single trial slot atomically here,
+        under the placement lock."""
+        with self._lock:
+            avail = [b for b in self.backends
+                     if b not in exclude and b.can_accept()]
             if not avail:
                 return None
             best = min(b.outstanding for b in avail)
@@ -267,32 +360,85 @@ class Router:
             self._rr += 1
             choice.outstanding += 1
             choice.dispatched += 1
+            if choice.state == HALF_OPEN:
+                choice.trial_in_flight = True
             return choice
 
     def _release(self, backend: Backend) -> None:
         with self._lock:
             backend.outstanding = max(backend.outstanding - 1, 0)
 
-    def _note_failure(self, backend: Backend) -> None:
-        """Penalise a backend and count the retry under the placement lock
-        — ``pick()`` reads the penalty window under the same lock, and the
-        retry counter is bumped from every per-connection handler."""
+    def _breaker_failure(self, backend: Backend) -> None:
+        """One observed failure (transport, torn line, drain refusal,
+        hung/failed probe): open the breaker once the threshold is hit; a
+        failed half-open trial goes straight back to open."""
         with self._lock:
-            backend.penalize(time.monotonic())
+            backend.failures += 1
+            backend.consecutive_failures += 1
+            if backend.state == HALF_OPEN:
+                backend.state = OPEN
+                backend.opened_at = time.monotonic()
+                backend.trial_in_flight = False
+                self.counters["breaker_opens_total"] += 1
+            elif backend.state == CLOSED and backend.consecutive_failures \
+                    >= int(self.cfg.breaker_threshold):
+                backend.state = OPEN
+                backend.opened_at = time.monotonic()
+                self.counters["breaker_opens_total"] += 1
+
+    def _note_failure(self, backend: Backend) -> None:
+        """A dispatch-path failure: breaker bookkeeping + retry count."""
+        self._breaker_failure(backend)
+        with self._lock:
             self.retries += 1
+
+    def _note_success(self, backend: Backend) -> None:
+        """A completed round trip: reset the failure streak; a half-open
+        trial success (or a completion that outlived the breaker opening)
+        closes the breaker."""
+        with self._lock:
+            backend.consecutive_failures = 0
+            if backend.state in (HALF_OPEN, OPEN):
+                backend.state = CLOSED
+                backend.trial_in_flight = False
+                self.counters["breaker_closes_total"] += 1
+
+    def _note_probe_success(self, backend: Backend) -> None:
+        """A ping/stats answer from an open backend: recovery OBSERVED —
+        half-open it so the next request runs the trial."""
+        with self._lock:
+            backend.consecutive_failures = 0
+            if backend.state == OPEN:
+                backend.state = HALF_OPEN
+                backend.trial_in_flight = False
 
     # ------------------------------------------------------------- dispatch
     def dispatch(self, payload: dict) -> dict:
         """Forward one request, re-dispatching across backends until a
-        replica completes it or the deadline passes."""
+        replica completes it, the dispatch deadline passes, or the retry
+        budget is spent."""
         rid = payload.get("id")
-        deadline = time.monotonic() + DISPATCH_DEADLINE_S
+        deadline = time.monotonic() + float(self.cfg.dispatch_deadline_s)
         attempts = 0
+        idle_waits = 0
         while time.monotonic() < deadline:
+            if attempts >= int(self.cfg.retry_budget):
+                # budget spent: a classified refusal beats grinding the
+                # fleet with a request that keeps losing backends
+                self._count("no_backend_total")
+                self.journal.note(rid, "budget_exhausted",
+                                  attempts=attempts)
+                return {"id": rid,
+                        "error": f"retry budget exhausted "
+                                 f"({attempts} attempts)"}
             backend = self.pick()
             if backend is None:
-                time.sleep(0.05)  # whole fleet penalised — restart window
+                # every breaker open (or trial-busy): wait out the
+                # restart window on jittered exponential backoff
+                idle_waits += 1
+                time.sleep(self._spin.sleep_for(idle_waits))
                 continue
+            idle_waits = 0
             addr = _addr_str(backend.addr)
             attempts += 1
             self._count("dispatched_total")
@@ -300,42 +446,130 @@ class Router:
                 self._count("redispatched_total")
             self.journal.note(rid, "dispatch", backend=addr,
                               attempt=attempts)
-            try:
-                resp = self._forward(backend, payload)
-            except (OSError, ValueError):
-                # transport failure OR a torn/garbled response line (a
-                # replica killed mid-write) — both mean "this backend did
-                # not complete the request": penalise and re-dispatch
-                self._note_failure(backend)
-                self._count("penalties_total")
-                self.journal.note(rid, "transport_retry", backend=addr)
-                continue
-            finally:
-                self._release(backend)
-            if resp.get("error") == "draining":
+            resp = self._race(backend, payload, rid)
+            if resp is None:
+                continue  # every racer failed/refused — re-dispatch
+            self._count("completed_total")
+            self.journal.note(rid, "completed", backend=resp[1],
+                              error=resp[0].get("error"))
+            return resp[0]
+        self._count("no_backend_total")
+        self.journal.note(rid, "no_backend")
+        return {"id": rid, "error": "no backend available"}
+
+    def _attempt(self, backend: Backend, payload: dict, rid,
+                 results: "queue.Queue") -> None:
+        """One forward on one backend, outcome classified inline — runs
+        on its own thread so a hung racer can't hold the dispatch loop.
+        Breaker bookkeeping happens HERE, not in the collector: a loser
+        whose transport failure lands after the race concluded (the
+        blackholed-replica shape) still opens its breaker."""
+        addr = _addr_str(backend.addr)
+        try:
+            resp = self._forward(backend, payload)
+        except (OSError, ValueError):
+            # transport failure OR a torn/garbled response line (a
+            # replica killed mid-write) — both mean "this backend did
+            # not complete the request": open-count and let the
+            # collector re-dispatch
+            self._note_failure(backend)
+            self._count("penalties_total")
+            self.journal.note(rid, "transport_retry", backend=addr)
+            results.put((backend, None))
+        else:
+            if isinstance(resp, dict) and resp.get("error") == "draining":
                 # graceful reclaim: stop placing onto this backend and
                 # retry the request elsewhere, losing nothing
                 self._note_failure(backend)
                 self._count("penalties_total")
                 self._count("drain_refusals_total")
                 self.journal.note(rid, "drain_refusal", backend=addr)
+                results.put((backend, None))
+            else:
+                self._note_success(backend)
+                results.put((backend, resp))
+        finally:
+            self._release(backend)
+
+    def _race(self, backend: Backend, payload: dict, rid):
+        """One dispatch attempt with hedging: after ``hedge_ms`` of
+        silence from ``backend`` the same request races one extra
+        replica; first complete answer wins and the loser is torn down
+        via the ``cancel`` verb (decode is idempotent — loss-free).
+        Returns ``(response, winner_addr)`` or None when every racer
+        failed/refused (the caller re-dispatches)."""
+        results: "queue.Queue" = queue.Queue()
+        racers: list = []
+
+        def launch(b) -> None:
+            racers.append(b)
+            threading.Thread(target=self._attempt,
+                             args=(b, payload, rid, results),
+                             daemon=True, name="router-dispatch").start()
+
+        launch(backend)
+        hedge_s = float(self.cfg.hedge_ms) / 1000.0
+        started = time.monotonic()
+        deadline = started + self.request_timeout
+        done: list = []
+        while len(done) < len(racers):
+            now = time.monotonic()
+            if now >= deadline:
+                return None  # racers still out will teach breakers late
+            wait = deadline - now
+            if hedge_s > 0 and len(racers) == 1:
+                wait = min(wait, max(started + hedge_s - now, 0.0))
+            try:
+                b, resp = results.get(timeout=max(wait, 0.001))
+            except queue.Empty:
+                if hedge_s > 0 and len(racers) == 1 \
+                        and time.monotonic() - started >= hedge_s:
+                    second = self.pick(exclude=tuple(racers))
+                    if second is not None:
+                        self._count("hedges_total")
+                        self.journal.note(rid, "hedge",
+                                          backend=_addr_str(second.addr))
+                        launch(second)
                 continue
-            self._count("completed_total")
-            self.journal.note(rid, "completed", backend=addr,
-                              error=resp.get("error"))
-            return resp
-        self._count("no_backend_total")
-        self.journal.note(rid, "no_backend")
-        return {"id": rid, "error": "no backend available"}
+            done.append(b)
+            if resp is not None:
+                for loser in racers:
+                    if loser is not b and loser not in done:
+                        self._cancel_on(loser, rid)
+                return resp, _addr_str(b.addr)
+        return None
+
+    def _cancel_on(self, backend: Backend, rid) -> None:
+        """Fire-and-forget ``cancel`` to a hedge loser: the replica frees
+        the request's slot at its next step boundary. A cancel that loses
+        its own race to completion is harmless — decode is idempotent and
+        the router already returned the winner."""
+        self._count("hedge_cancels_total")
+        self.journal.note(rid, "hedge_cancel",
+                          backend=_addr_str(backend.addr))
+        if rid is None:
+            return  # unjournaled request: the replica can't look it up
+
+        def run() -> None:
+            try:
+                self._ask(backend.addr, {"verb": "cancel", "id": str(rid)})
+            except (OSError, ValueError):
+                pass  # loser is crashing/hung — its breaker handles it
+
+        threading.Thread(target=run, daemon=True,
+                         name="router-hedge-cancel").start()
 
     def _forward(self, backend: Backend, payload: dict) -> dict:
         return self._ask(backend.addr, payload,
                          timeout=self.request_timeout)
 
     def _ask(self, addr: tuple, payload: dict,
-             timeout: float = VERB_TIMEOUT_S) -> dict:
+             timeout: Optional[float] = None) -> dict:
         """One JSON-line round trip (``OSError``/``ValueError`` on
-        transport failure or a torn line — callers decide the retry)."""
+        transport failure or a torn line — callers decide the retry).
+        Default timeout is the configured verb timeout."""
+        if timeout is None:
+            timeout = float(self.cfg.verb_timeout_s)
         with socket.create_connection(addr, timeout=timeout) as conn:
             conn.sendall((json.dumps(payload) + "\n").encode("utf-8"))
             conn.settimeout(timeout)
@@ -344,6 +578,37 @@ class Router:
             raise ConnectionError(f"empty response from {addr}")
         # a torn line (replica died mid-write) raises ValueError → retry
         return json.loads(buf.decode("utf-8"))
+
+    # --------------------------------------------------------------- probes
+    def probe_once(self) -> None:
+        """One health sweep: ``ping`` every backend. The replica answers
+        ping on its handler thread — never queued behind decode — so a
+        busy replica stays closed while a hung/blackholed one fails the
+        probe and opens WITHOUT having to burn a live request. An open
+        backend past its ``penalty_s`` holdoff that answers again is
+        half-opened: recovery observed, never assumed from a timer."""
+        now = time.monotonic()
+        for backend in self.backends:
+            with self._lock:
+                state = backend.state
+                opened_at = backend.opened_at
+            if state == OPEN and \
+                    now - opened_at < float(self.cfg.penalty_s):
+                continue  # holdoff: a supervisor restart needs a moment
+            try:
+                resp = self._ask(backend.addr, {"verb": "ping"})
+            except (OSError, ValueError):
+                self._breaker_failure(backend)
+                continue
+            if isinstance(resp, dict) and resp.get("ok") is True \
+                    and not resp.get("draining"):
+                self._note_probe_success(backend)
+            else:
+                self._breaker_failure(backend)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(float(self.cfg.probe_interval_s)):
+            self.probe_once()
 
     # --------------------------------------------------------------- verbs
     def poll_fleet(self) -> dict:
@@ -363,9 +628,12 @@ class Router:
             if not isinstance(resp, dict) or resp.get("error"):
                 continue
             snaps[addr] = resp
+            # a stats answer is as good as a ping: recovery observed
+            self._note_probe_success(backend)
         record = merge_fleet_snapshots(
             snaps, replicas_total=len(self.backends),
-            router_counters=self.router_counters())
+            router_counters=self.router_counters(),
+            breakers=self.breaker_states())
         self.last_fleet = record
         return record
 
@@ -426,6 +694,11 @@ class Router:
         self.port = self._listener.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="router-accept").start()
+        # breakers need probes to observe recovery (and to catch a
+        # blackholed replica before it eats a live request) — the sweep
+        # runs for every started router, fleet sink or not
+        threading.Thread(target=self._probe_loop, daemon=True,
+                         name="router-health-probe").start()
         if self.fleet_out:
             # stdlib-only sink reuse (sinks.py imports jax lazily now):
             # the fleet stream is line-buffered JSONL like every other
@@ -501,14 +774,21 @@ def main(argv=None) -> int:
     ap.add_argument("--poll-interval", type=float,
                     default=DEFAULT_POLL_INTERVAL_S,
                     help="seconds between backend stats sweeps")
+    ap.add_argument("--router-config", default=None,
+                    help="JSON dict of Serving.router knobs "
+                         "(RouterConfig fields — tools/serve.py "
+                         "forwards the YAML block this way)")
     args = ap.parse_args(argv)
     backends = []
     for spec in args.backends.split(","):
         h, _, p = spec.strip().rpartition(":")
         backends.append((h or "127.0.0.1", int(p)))
+    config = RouterConfig.from_dict(json.loads(args.router_config)) \
+        if args.router_config else None
     router = Router(backends, host=args.host, port=args.port,
                     fleet_out=args.fleet_out,
-                    poll_interval=args.poll_interval)
+                    poll_interval=args.poll_interval,
+                    config=config)
     port = router.start()
     print(f"[router] listening on {args.host}:{port} over "
           f"{len(backends)} backend(s)"

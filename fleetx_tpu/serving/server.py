@@ -2,16 +2,34 @@
 
 Wire protocol (one request per connection, newline-delimited JSON)::
 
-    → {"id": "r1", "prompt": [5, 9, 23], "max_new_tokens": 8}
+    → {"id": "r1", "prompt": [5, 9, 23], "max_new_tokens": 8,
+       "deadline_s": 2.5}                        # deadline optional
     ← {"id": "r1", "tokens": [41, 3, ...], "ttft_s": 0.01, "latency_s": 0.2}
     ← {"id": "r1", "error": "draining"}          # replica is being reclaimed
+    ← {"id": "r1", "error": "overloaded: ...", "retry_after_s": 0.8}
 
-Two read-only **verbs** ride the same protocol (docs/serving.md
-"Observability") — the router polls the first, operators ask the second::
+Refusals are CLASSIFIED (docs/serving.md "Fault tolerance"): ``draining``
+means the replica is being reclaimed (re-dispatch elsewhere),
+``overloaded``/``unmeetable`` are deadline-admission verdicts carrying a
+``retry_after_s`` hint, and ``deadline_shed``/``cancelled`` end requests
+that were already in flight.
+
+Four **verbs** ride the same protocol (docs/serving.md "Observability") —
+the router polls the first two, operators ask the third, hedged dispatch
+fires the fourth::
 
     → {"verb": "stats"}                    ← one serving_snapshot() record
+    → {"verb": "ping"}                     ← {"ok": true, "draining": false}
+                                             (answered on the HANDLER
+                                             thread — cheap liveness for
+                                             the router's health probes,
+                                             never queued behind decode)
     → {"verb": "trace", "id": "r1"}        ← the request's lifecycle
                                              timeline + phase attribution
+    → {"verb": "cancel", "id": "r1"}       ← {"id": "r1", "cancelled": true}
+                                             (frees the request's slot at
+                                             the next step boundary — the
+                                             hedge loser's teardown)
 
 The engine loop stays on the caller's (main) thread — connection handler
 threads only enqueue submissions (and verb thunks, which the loop services
@@ -28,6 +46,7 @@ that is going away.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import socket
 import threading
@@ -121,8 +140,23 @@ class ReplicaServer:
             if not isinstance(msg, dict):
                 send_json_line(conn, {"error": "bad request"})
                 return
+            if self.fault_plan is not None and self.fault_plan.blackholed():
+                # chaos knob ``blackhole_after``: accept, never answer —
+                # the hung-process shape. Hold the connection open so the
+                # client sees silence (a close would read as a crash and
+                # trip the fast transport-retry path instead)
+                self._stop.wait(REQUEST_TIMEOUT_S)
+                return
             verb = msg.get("verb")
-            if verb in ("stats", "trace"):
+            if verb == "ping":
+                # liveness answers on THIS thread, never queued behind
+                # decode: a busy replica still pings, a hung one doesn't —
+                # exactly the distinction the router's breakers probe for
+                send_json_line(conn, {"ok": True,
+                                      "draining":
+                                          bool(self.engine.draining)})
+                return
+            if verb in ("stats", "trace", "cancel"):
                 send_json_line(conn, self._control_call(verb, msg))
                 return
             if "prompt" not in msg:
@@ -147,13 +181,27 @@ class ReplicaServer:
                                       "error": "timeout"})
                 return
             req = box["req"]
+            if self.fault_plan is not None and \
+                    self.fault_plan.take_crash_mid_write():
+                # chaos knob ``crash_mid_write``: tear the response line
+                # mid-JSON and die — the router must see a transport-level
+                # parse failure, never hand the torn payload to a client
+                try:
+                    conn.sendall(b'{"id": "' + req.id.encode() + b'", "tok')
+                finally:
+                    os._exit(70)
             if req.error:
-                send_json_line(conn, {"id": req.id, "error": req.error})
+                resp = {"id": req.id, "error": req.error}
+                if getattr(req, "retry_after_s", None) is not None:
+                    resp["retry_after_s"] = req.retry_after_s
+                send_json_line(conn, resp)
             else:
                 send_json_line(conn, {
                     "id": req.id, "tokens": req.tokens,
                     "ttft_s": req.ttft_s,
                     "latency_s": req.finished_at - req.submitted_at})
+            if self.fault_plan is not None:
+                self.fault_plan.note_response()
         except OSError:
             pass  # client went away; the engine finishes the work regardless
         finally:
@@ -177,6 +225,10 @@ class ReplicaServer:
             try:
                 if verb == "stats":
                     box["resp"] = self.engine.serving_snapshot()
+                elif verb == "cancel":
+                    rid = str(msg.get("id"))
+                    box["resp"] = {"id": rid,
+                                   "cancelled": self.engine.cancel(rid)}
                 else:
                     rid = str(msg.get("id"))
                     tr = self.engine.request_trace(rid)
@@ -206,9 +258,13 @@ class ReplicaServer:
                 msg, on_done = self._submissions.get_nowait()
             except queue.Empty:
                 return
+            deadline = msg.get("deadline_s")
             self.engine.submit(msg["prompt"],
                                int(msg.get("max_new_tokens") or 16),
-                               request_id=msg.get("id"), callback=on_done)
+                               request_id=msg.get("id"), callback=on_done,
+                               deadline_s=(float(deadline)
+                                           if deadline is not None
+                                           else None))
 
     def run(self, preemption=None, idle_sleep: float = 0.002) -> None:
         """The scheduler loop; returns once a latched preemption has fully
@@ -237,6 +293,12 @@ class ReplicaServer:
                     # sigterm-at-step drill (resilience/faults.py):
                     # SIGTERM ourselves after N engine work-steps
                     self.fault_plan.maybe_sigterm(work_steps)
+                    # straggler knob ``slow_decode_ms_at``: stretch the
+                    # step cadence so measured ITL genuinely inflates —
+                    # the shape hedged dispatch exists to beat
+                    delay = self.fault_plan.decode_delay_s(work_steps)
+                    if delay:
+                        time.sleep(delay)
             else:
                 if self.engine.draining and self._submissions.empty():
                     break

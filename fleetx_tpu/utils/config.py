@@ -351,6 +351,27 @@ def process_serving_config(config: AttrDict) -> AttrDict:
         v = serving.get(key)
         if v is not None and int(v) <= 0:
             raise ValueError(f"Serving.{key} must be > 0, got {v!r}")
+    mq = serving.get("max_queue")
+    if mq is not None and int(mq) < 0:
+        raise ValueError(
+            f"Serving.max_queue must be >= 0 (0 = unbounded admission "
+            f"queue), got {mq!r}")
+    # the router block validates through the SAME dataclass the router
+    # boots from (serving/router.py — stdlib-only, cheap import): a
+    # typo'd breaker knob fails at config load, not when the fleet
+    # first degrades and the breaker math actually runs
+    router = serving.get("router")
+    if router is not None:
+        if not isinstance(router, dict):
+            raise ValueError(
+                f"Serving.router must be a mapping of router knobs, "
+                f"got {router!r}")
+        from fleetx_tpu.serving.router import RouterConfig
+
+        try:
+            RouterConfig.from_dict(dict(router))
+        except (AssertionError, TypeError, ValueError) as e:
+            raise ValueError(f"Serving.router invalid: {e}") from e
     return config
 
 

@@ -113,6 +113,18 @@ SERVING_METRICS = {
     # pool and decode is thrashing
     "serving.page_occupancy_mean": ("higher", 0.15, 0.05),
     "serving.preemption_rate": ("lower", 0.0, 0.25),
+    # fault-tolerance rows (PR 19, docs/serving.md "Fault tolerance"):
+    # pure absolute bands — counts, not rates, on the fixed-size bench.
+    # A handful of deadline sheds is admission doing its job under the
+    # bimodal burst, but +2 over baseline means the projection math or
+    # the shed path regressed; hedges only fire on genuine stragglers so
+    # a +3 jump means the hedge timer got trigger-happy (each hedge
+    # burns a duplicate decode); breaker opens on the in-process bench
+    # (no real fleet) should stay at 0 — any opening means the counters
+    # wired into the bench path are misfiring. All skip-if-absent.
+    "serving.deadline_sheds": ("lower", 0.0, 2.0),
+    "serving.hedges_total": ("lower", 0.0, 3.0),
+    "serving.breaker_opens": ("lower", 0.0, 0.5),
 }
 
 
@@ -324,6 +336,27 @@ def self_check(baseline_entry: dict) -> list[str]:
     rows = compare(drifted_lz, lz)
     for metric in ("serving.page_occupancy_mean",
                    "serving.preemption_rate"):
+        if not any(r["metric"] == metric and r["verdict"] == "FAIL"
+                   for r in rows):
+            problems.append(f"synthetic {metric} regression NOT caught")
+    # fault-tolerance serving rows (their real rows skip-if-absent on
+    # pre-PR-19 baselines): identical copies pass; a shed-count jump past
+    # the +2 band, a hedge burst past +3, and ANY breaker opening on the
+    # in-process bench must all fail
+    ft_sv = dict(baseline_entry)
+    ft_sv["serving"] = {"deadline_sheds": 1.0, "hedges_total": 0.0,
+                        "breaker_opens": 0.0}
+    rows = compare(json.loads(json.dumps(ft_sv)), ft_sv)
+    if any(r["verdict"] == "FAIL" for r in rows):
+        problems.append(
+            "identical fault-tolerance rows flagged as regression")
+    drifted_fs = json.loads(json.dumps(ft_sv))
+    drifted_fs["serving"]["deadline_sheds"] = 4.0
+    drifted_fs["serving"]["hedges_total"] = 4.0
+    drifted_fs["serving"]["breaker_opens"] = 1.0
+    rows = compare(drifted_fs, ft_sv)
+    for metric in ("serving.deadline_sheds", "serving.hedges_total",
+                   "serving.breaker_opens"):
         if not any(r["metric"] == metric and r["verdict"] == "FAIL"
                    for r in rows):
             problems.append(f"synthetic {metric} regression NOT caught")

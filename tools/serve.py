@@ -226,6 +226,18 @@ def main(argv=None) -> int:
                        "--poll-interval", str(args.poll_interval)]
         if args.fleet_out:
             router_argv += ["--fleet-out", args.fleet_out]
+        if args.config:
+            # the Serving.router YAML block rides to the (stdlib-only)
+            # router process as JSON — validated eagerly here so a bad
+            # knob fails before the fleet front binds
+            from fleetx_tpu.utils import config as config_mod
+
+            cfg = config_mod.parse_config(args.config)
+            config_mod.override_config(cfg, args.override)
+            config_mod.process_serving_config(cfg)
+            block = dict((cfg.get("Serving") or {}).get("router") or {})
+            if block:
+                router_argv += ["--router-config", json.dumps(block)]
         return router_main(router_argv)
 
     if not args.config:

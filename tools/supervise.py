@@ -30,17 +30,34 @@ checkpoint-resume works. This wrapper owns the full process lifecycle:
   failing host, reporting which one (exit 41). A host that computes or
   remembers wrong would otherwise join the gang and corrupt every
   replica-collective decision silently.
+- **elastic serving** (``--elastic``): serving replicas are NOT a gang —
+  they share no collective, so one crash must never tear the others
+  down. Each member restarts INDIVIDUALLY with per-member backoff
+  (crash codes only; preemption/rc-0 retire the slot), ``--min-healthy``
+  gates the launch and trips the supervisor when the live count can no
+  longer reach it, and a first scale-up/down rung moves the live replica
+  count within ``[min-healthy, num-procs]`` on SLO burn-rate read from
+  the router's ``--fleet-out`` records (``--fleet-records``): sustained
+  budget burn > 1 relaunches a stopped rung, sustained full attainment
+  drains the highest one (SIGTERM → graceful drain → preemption exit).
+  The router's breakers make rung membership safe: a stopped replica's
+  breaker is simply open until the rung returns. docs/serving.md
+  "Fault tolerance" is the operator story.
 
 Usage (what ``projects/*.sh`` invoke)::
 
     python tools/supervise.py [--max-restart N] [--num-procs P] -- \
         python tools/train.py -c cfg.yaml ...
+    python tools/supervise.py --elastic --num-procs 3 --min-healthy 2 \
+        --fleet-records fleet.jsonl -- \
+        python tools/serve.py -c serving.yaml --port 9000
 """
 
 from __future__ import annotations
 
 import argparse
 import glob
+import json
 import os
 import signal
 import socket
@@ -188,6 +205,242 @@ def _preflight(num_procs: int, timeout: float) -> list:
     return failures
 
 
+class Member:
+    """One elastic serving replica slot — launched, restarted and
+    drained INDIVIDUALLY (never gang-killed with its siblings)."""
+
+    def __init__(self, cmd: list, rank: int, flight_base: str | None):
+        self.cmd = list(cmd)
+        self.rank = int(rank)
+        self.flight_base = flight_base
+        self.generation = -1
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+        self.next_launch_at = 0.0  # monotonic; backoff gate
+        self.stopped = False       # retired/scaled-down rung
+
+    def launch(self) -> None:
+        """(Re)start this slot. ``FLEETX_PROCESS_ID`` gives the replica
+        its port offset (tools/serve.py) — NOT a jax gang id: elastic
+        members never get a coordinator address."""
+        self.generation += 1
+        env = dict(os.environ, FLEETX_PROCESS_ID=str(self.rank))
+        if self.flight_base:
+            env["FLEETX_FLIGHT_DIR"] = os.path.join(
+                self.flight_base, f"member{self.rank}",
+                f"gen{self.generation}")
+        self.proc = subprocess.Popen(self.cmd, env=env,
+                                     start_new_session=True)
+        self.stopped = False
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def signal(self, sig: int) -> None:
+        if self.alive():
+            try:
+                os.killpg(os.getpgid(self.proc.pid), sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def _read_last_record(path: str) -> dict | None:
+    """Last JSONL record of the router's ``--fleet-out`` stream (None
+    when the file is missing/empty/torn — the scale rung then holds)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(size - 65536, 0))
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            rec = json.loads(ln.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            continue  # torn tail line mid-append
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+def _burn_rate(record: dict | None, slo_target: float) -> float | None:
+    """SLO error-budget burn rate from one fleet record: how fast the
+    fleet is spending its ``1 - target`` budget (1.0 = exactly on
+    budget, >1 = burning, 0 = full attainment). None when the record
+    carries no attainment (no completed requests in the window)."""
+    if not record:
+        return None
+    att = record.get("slo_attainment")
+    if not isinstance(att, (int, float)) or isinstance(att, bool):
+        return None
+    budget = max(1.0 - float(slo_target), 1e-6)
+    return max(1.0 - float(att), 0.0) / budget
+
+
+class _ElasticEvents:
+    """Append-only JSONL of supervisor decisions (``--events-out``) —
+    the drill reads launches/restarts/scale moves off this stream."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+
+    def emit(self, event: str, **data) -> None:
+        print(f"[supervise] {event} "
+              + " ".join(f"{k}={v}" for k, v in data.items()),
+              file=sys.stderr)
+        if not self.path:
+            return
+        rec = {"ts": time.time(), "event": event, **data}
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # evidence stream must never kill the control loop
+
+
+def _run_elastic(args, cmd: list, clean_codes: set,
+                 forwarded: dict, members: list) -> int:
+    """Elastic serving supervision loop (``--elastic``).
+
+    Invariants: a crashed member restarts alone with per-member
+    exponential backoff; a preemption/rc-0 exit retires its rung; the
+    live count never intentionally drops below ``--min-healthy`` and
+    the supervisor exits 1 when crashes make the gate unreachable; the
+    scale rung moves one member at a time on sustained SLO burn-rate
+    evidence from the router's fleet records.
+    """
+    events = _ElasticEvents(args.events_out)
+    desired = len(members)
+    burn_high = 0  # consecutive windows over budget
+    burn_zero = 0  # consecutive windows at full attainment
+    last_scale_check = time.monotonic()
+    for m in members:
+        m.launch()
+        events.emit("launch", member=m.rank, pid=m.proc.pid)
+
+    # ---- launch gate: min-healthy must come up (and stay up through
+    # the settle window) before this supervisor calls the fleet live
+    gate_deadline = time.monotonic() + args.gate_timeout
+    while time.monotonic() < gate_deadline:
+        if forwarded["sig"] is not None:
+            break
+        if sum(m.alive() for m in members) >= args.min_healthy:
+            events.emit("gate_passed",
+                        healthy=sum(m.alive() for m in members),
+                        min_healthy=args.min_healthy)
+            break
+        time.sleep(0.2)
+    else:
+        events.emit("gate_failed",
+                    healthy=sum(m.alive() for m in members),
+                    min_healthy=args.min_healthy)
+        for m in members:
+            m.signal(signal.SIGTERM)
+        return 1
+
+    while True:
+        now = time.monotonic()
+        if forwarded["sig"] is not None:
+            # operator/scheduler stop: drain every live member and wait
+            for m in members:
+                m.signal(forwarded["sig"])
+            deadline = now + args.grace
+            while any(m.alive() for m in members) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.2)
+            for m in members:
+                if m.alive():
+                    m.signal(signal.SIGKILL)
+            events.emit("stopped", signal=forwarded["sig"])
+            return 0
+
+        # ---- individual restart path (the anti-gang): classify exits
+        for m in members:
+            if m.proc is None or m.alive() or m.stopped:
+                continue
+            rc = m.proc.returncode
+            if rc in clean_codes:
+                # graceful drain (scale-down, preemption, clean stop):
+                # the rung retires; scale-up may relaunch it later
+                m.stopped = True
+                events.emit("retired", member=m.rank, rc=rc)
+                continue
+            m.restarts += 1
+            if m.restarts > args.max_restart:
+                m.stopped = True
+                events.emit("gave_up", member=m.rank,
+                            restarts=m.restarts - 1, rc=rc)
+                continue
+            backoff = args.backoff * (2 ** (m.restarts - 1))
+            m.next_launch_at = now + backoff
+            m.proc = None
+            events.emit("crash", member=m.rank, rc=_shell_code(rc),
+                        restart_in_s=round(backoff, 2),
+                        attempt=m.restarts)
+        for m in members:
+            if m.proc is None and not m.stopped \
+                    and now >= m.next_launch_at:
+                live = sum(x.alive() for x in members)
+                if live >= desired:
+                    continue  # rung shrank while this slot backed off
+                m.launch()
+                events.emit("restart", member=m.rank, pid=m.proc.pid,
+                            attempt=m.restarts)
+
+        # ---- min-healthy trip: count slots that can still serve
+        viable = sum(1 for m in members
+                     if m.alive() or (m.proc is None and not m.stopped))
+        recoverable = viable + sum(1 for m in members
+                                   if m.stopped and
+                                   m.restarts <= args.max_restart)
+        if recoverable < args.min_healthy:
+            events.emit("below_min_healthy", viable=viable,
+                        min_healthy=args.min_healthy)
+            for m in members:
+                m.signal(signal.SIGTERM)
+            return 1
+
+        # ---- scale rung: one member per sustained burn-rate signal
+        if args.fleet_records and \
+                now - last_scale_check >= args.scale_interval:
+            last_scale_check = now
+            burn = _burn_rate(_read_last_record(args.fleet_records),
+                              args.slo_target)
+            if burn is None:
+                pass  # no attainment evidence — hold the rung
+            elif burn > 1.0:
+                burn_high, burn_zero = burn_high + 1, 0
+            elif burn == 0.0:
+                burn_zero, burn_high = burn_zero + 1, 0
+            else:
+                burn_high = burn_zero = 0
+            if burn_high >= args.scale_window and desired < len(members):
+                desired += 1
+                burn_high = 0
+                stopped = [m for m in members
+                           if m.stopped or m.proc is None]
+                if stopped:
+                    m = min(stopped, key=lambda x: x.rank)
+                    m.restarts = 0
+                    m.launch()
+                    events.emit("scale_up", member=m.rank,
+                                desired=desired, burn_rate=round(burn, 3))
+            if burn_zero >= args.scale_window and \
+                    desired > args.min_healthy:
+                desired -= 1
+                burn_zero = 0
+                live = [m for m in members if m.alive()]
+                if len(live) > args.min_healthy:
+                    m = max(live, key=lambda x: x.rank)
+                    m.stopped = True  # retire BEFORE the drain lands
+                    m.signal(signal.SIGTERM)
+                    events.emit("scale_down", member=m.rank,
+                                desired=desired)
+        time.sleep(0.2)
+
+
 def main(argv=None) -> int:
     """Supervisor entry point; returns the process exit code."""
     parser = argparse.ArgumentParser(description="fleetx gang supervisor")
@@ -219,6 +472,30 @@ def main(argv=None) -> int:
                              "per-generation FLEETX_FLIGHT_DIR under it "
                              "(default: $FLEETX_FLIGHT_DIR or "
                              "./flight_recorder)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="serving mode: members restart individually "
+                             "with backoff instead of gang-restarting "
+                             "(they share no collective)")
+    parser.add_argument("--min-healthy", type=int, default=1,
+                        help="elastic: launch gate + floor — the live "
+                             "member count the fleet must reach and hold")
+    parser.add_argument("--gate-timeout", type=float, default=120.0,
+                        help="elastic: seconds the launch gate waits for "
+                             "--min-healthy members to come up")
+    parser.add_argument("--fleet-records", default=None,
+                        help="elastic: the router's --fleet-out JSONL; "
+                             "its slo_attainment drives the scale rung")
+    parser.add_argument("--slo-target", type=float, default=0.99,
+                        help="elastic: attainment target whose error "
+                             "budget the burn rate is measured against")
+    parser.add_argument("--scale-interval", type=float, default=2.0,
+                        help="elastic: seconds between burn-rate checks")
+    parser.add_argument("--scale-window", type=int, default=3,
+                        help="elastic: consecutive over/under-budget "
+                             "checks before the rung moves one member")
+    parser.add_argument("--events-out", default=None,
+                        help="elastic: append supervisor decision events "
+                             "(launch/crash/restart/scale) as JSONL here")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- followed by the training command")
     args = parser.parse_args(argv)
@@ -243,6 +520,30 @@ def main(argv=None) -> int:
     flight_base = (args.flight_dir
                    or os.environ.get("FLEETX_FLIGHT_DIR")
                    or "./flight_recorder")
+
+    if args.elastic:
+        assert 1 <= args.min_healthy <= args.num_procs, \
+            "--min-healthy must be within [1, --num-procs]"
+        members = [Member(cmd, rank, flight_base)
+                   for rank in range(args.num_procs)]
+        forwarded = {"sig": None}
+
+        def _note(signum, frame):
+            # elastic members are signaled by the control loop itself —
+            # the handler only records the stop ask
+            forwarded["sig"] = signum
+            print(f"[supervise] signal {signum} — draining the fleet",
+                  file=sys.stderr)
+
+        previous = {s: signal.signal(s, _note)
+                    for s in (signal.SIGTERM, signal.SIGINT)}
+        try:
+            return _run_elastic(args, cmd, clean_codes, forwarded,
+                                members)
+        finally:
+            for s, h in previous.items():
+                signal.signal(s, h)
+
     gang = Gang(cmd, args.num_procs, flight_base=flight_base)
     forwarded = {"sig": None}
 
