@@ -48,6 +48,11 @@ VOCAB_CHUNK = int(os.environ.get("FLEETX_BENCH_VOCAB_CHUNK", 0))
 # the plain data-parallel step. Single-device runs exercise the code path
 # with fsdp=1 (constraints become no-ops).
 ZERO_STAGE = int(os.environ.get("FLEETX_BENCH_ZERO_STAGE", 0))
+# overlapped sharded update (docs/bandwidth_levers.md): with stage >= 2,
+# params live on the grad shards and the allgather moves into the loss
+# where it overlaps the next forward. Only meaningful with ZERO_STAGE >= 2.
+OVERLAP_UPDATE = os.environ.get(
+    "FLEETX_BENCH_OVERLAP_UPDATE", "").lower() in ("1", "true")
 HIDDEN, LAYERS, VOCAB = 1024, 24, 50304
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -171,6 +176,13 @@ def _bench_impl() -> dict:
     if fused_bwd_env is not None:
         model_kwargs["flash_fused_bwd"] = \
             fused_bwd_env.lower() not in ("0", "false", "")
+    # fused residual+LayerNorm A/B (docs/bandwidth_levers.md): force either
+    # side; unset keeps the model default (on where the kernel predicate
+    # admits the shape)
+    fused_norm_env = os.environ.get("FLEETX_BENCH_FUSED_NORM")
+    if fused_norm_env is not None:
+        model_kwargs["fused_residual_norm"] = \
+            fused_norm_env.lower() not in ("0", "false", "")
     cfg = {
         "Model": dict(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=layers,
                       num_attention_heads=16, ffn_hidden_size=4096,
@@ -204,7 +216,8 @@ def _bench_impl() -> dict:
         cfg["Distributed"] = {
             "dp_degree": 1, "fsdp_degree": jax.device_count(),
             "sharding": {"sharding_stage": ZERO_STAGE,
-                         "sharding_degree": jax.device_count()}}
+                         "sharding_degree": jax.device_count(),
+                         "overlap_update": OVERLAP_UPDATE}}
     module = GPTModule(cfg)
     lr = build_lr_scheduler({"max_lr": 3e-4, "warmup_steps": 100,
                              "decay_steps": 1000})
@@ -367,6 +380,29 @@ def _bench_impl() -> dict:
     except Exception as e:
         result["flash_fused_bwd"] = f"error: {type(e).__name__}: {e}"[:120]
 
+    # which norm path compiled (docs/bandwidth_levers.md): the config knob
+    # AND the fused_norm kernel predicate for this config's activation
+    # shape — 0/1 ints (perf_gate's numeric schema rejects bools), so the
+    # gpt_fusednorm A/B and the perf_elementwise_ms band stay consistent
+    try:
+        import jax.numpy as jnp
+
+        from fleetx_tpu.ops import fused_norm as fnorm
+
+        mc = module.model_cfg
+        x_abs = jax.ShapeDtypeStruct((bsz, seq, mc.hidden_size), mc.dtype)
+        result["norm_fused"] = int(bool(
+            getattr(mc, "fused_residual_norm", False)
+            and fnorm.fused_norm_supported(x_abs, x_abs)))
+    except Exception as e:
+        result["norm_fused"] = f"error: {type(e).__name__}: {e}"[:120]
+    # overlapped sharded update evidence: what the ENGINE resolved — the
+    # gather shardings exist only when the knob survived the stage>=2 /
+    # fsdp>1 gates (the engine demotes it with a warning otherwise, never
+    # silently), i.e. exactly when the step really gathers inside the loss
+    result["update_overlapped"] = int(
+        getattr(engine, "_param_gather_shardings", None) is not None)
+
     # HBM attribution (docs/performance.md): measured peak vs auto_layout's
     # prediction for this exact config; "unavailable" is the explicit
     # marker for backends without memory_stats (axon tunnel, cpu) so an
@@ -407,6 +443,13 @@ def _bench_impl() -> dict:
             bwd_ms = result["decomposition"].get("bwd_scan_ms_per_layer")
             if bwd_ms is not None:
                 result["perf_bwd_ms_per_layer"] = bwd_ms
+            # the elementwise line the fused-norm kernel deletes (its time
+            # moves to the fused_norm category) — band-gated lower-is-
+            # better by tools/perf_gate.py
+            elem_ms = (rep.get("categories_ms_per_step") or {}) \
+                .get("elementwise")
+            if elem_ms is not None:
+                result["perf_elementwise_ms"] = elem_ms
         except Exception as e:
             result["decomposition_error"] = \
                 f"{type(e).__name__}: {e}"[:200]

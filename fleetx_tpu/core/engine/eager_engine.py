@@ -237,6 +237,18 @@ class EagerEngine(BasicEngine):
         self.sharding_stage = int((dist.get("sharding") or {}).get("sharding_stage") or 0)
         self.sharding_offload = bool(
             (dist.get("sharding") or {}).get("sharding_offload"))
+        # overlapped sharded update (docs/bandwidth_levers.md): params LIVE
+        # fsdp-sharded across steps and are allgathered inside the loss —
+        # the gather lands at the step head where it overlaps the forward,
+        # instead of serializing after the optimizer at the step tail
+        self.overlap_update = bool(
+            (dist.get("sharding") or {}).get("overlap_update"))
+        if self.overlap_update and self.sharding_stage < 2:
+            logger.warning(
+                "sharding.overlap_update needs sharding_stage >= 2 (the "
+                "update must consume reduce-scattered grad shards); "
+                "continuing without overlap")
+            self.overlap_update = False
         if self.sharding_offload:
             # offload is a fit-enabler that costs ~2.8x step time on-chip
             # (BENCHMARKS.md); flag configs that would fit without it
@@ -378,6 +390,20 @@ class EagerEngine(BasicEngine):
                     self.obs.registry.gauge("grad_bytes_sharded").set(
                         _sharded_grad_bytes(params_abs,
                                             self._grad_shardings))
+            self._param_gather_shardings = None
+            if (self.overlap_update and self._grad_shardings is not None):
+                # Overlapped update (docs/bandwidth_levers.md): store params
+                # ON the grad shards between steps, so the whole update
+                # chain (norm + clip + adam + apply) runs on 1/fsdp-sized
+                # operands, and move the param allgather INTO the loss
+                # (``gather_params`` in ``_build_step_fns``). XLA then
+                # schedules the gather at the head of the next step where it
+                # overlaps the forward's first matmuls — instead of a tail
+                # allgather that serializes after the optimizer. Same
+                # scheme as the tail of "Automatic Cross-Replica Sharding
+                # of Weight Update in Data-Parallel Training" (PAPERS.md).
+                self._param_gather_shardings = shardings.params
+                shardings = shardings.replace(params=self._grad_shardings)
             self._opt_dev_shardings = None
             if self.sharding_offload and self.sharding_stage >= 1:
                 # ZeRO offload (reference group_sharded_parallel
@@ -449,6 +475,12 @@ class EagerEngine(BasicEngine):
         grad_spec_leaves = None
         if getattr(self, "_grad_shardings", None) is not None:
             grad_spec_leaves = jax.tree.leaves(self._grad_shardings)
+        # overlapped update (docs/bandwidth_levers.md): params live on the
+        # grad shards between steps; these are the FULL specs the loss
+        # gathers them back to
+        gather_spec_leaves = None
+        if getattr(self, "_param_gather_shardings", None) is not None:
+            gather_spec_leaves = jax.tree.leaves(self._param_gather_shardings)
         # grad-accumulation carry dtype (Model.grad_accum_dtype): fp32
         # default, bf16 opt-in halves the live accumulator; None keeps the
         # grads' native dtype
@@ -467,8 +499,22 @@ class EagerEngine(BasicEngine):
                 jax.lax.with_sharding_constraint(g, s)
                 for g, s in zip(leaves, grad_spec_leaves)])
 
+        def gather_params(params):
+            """Allgather the fsdp-sharded resident params back to their full
+            (tensor-parallel-only) specs — INSIDE the loss, so the gather
+            sits at the head of the step where XLA overlaps it with the
+            forward's first matmuls, and its transpose (a reduce-scatter)
+            delivers the param cotangents already on the grad shards."""
+            if gather_spec_leaves is None:
+                return params
+            leaves, treedef = jax.tree.flatten(params)
+            return jax.tree.unflatten(treedef, [
+                jax.lax.with_sharding_constraint(p, s)
+                for p, s in zip(leaves, gather_spec_leaves)])
+
         def grads_and_metrics(params, scaler, batch, step):
             def loss_fn(p):
+                p = gather_params(p)
                 loss, metrics = module.training_loss(p, batch, base_rng, step)
                 if use_scaler:
                     loss = loss * scaler.loss_scale.astype(loss.dtype)
@@ -503,6 +549,7 @@ class EagerEngine(BasicEngine):
 
         self._update_fn = update_fn
         self._constrain_grads = constrain_grads
+        self._gather_params = gather_params
 
         def train_step(state: TrainState, batch: dict):
             if accum > 1:
@@ -601,7 +648,8 @@ class EagerEngine(BasicEngine):
                               opt_state=new_opt, scaler=new_scaler), metrics
 
         def eval_step(state: TrainState, batch: dict):
-            loss, metrics = module.validation_loss(state.params, batch)
+            loss, metrics = module.validation_loss(
+                gather_params(state.params), batch)
             return dict(metrics)
 
         bs = batch_sharding(self.mesh)

@@ -99,6 +99,12 @@ class GPTConfig:
     # only where fused_backward_supported admits the shape; other shapes
     # (wide heads, non-tiling seqs) keep the split kernels regardless.
     flash_fused_bwd: bool = True
+    # fused residual-add + f32 LayerNorm + output cast (ops/fused_norm.py):
+    # one Pallas pass per pre-norm LayerNorm deletes the elementwise HBM
+    # round-trips XLA bills around the norm (the `elementwise` trace line);
+    # shapes `fused_norm_supported` rejects keep the unfused jnp path.
+    # f32 loss/grads are bitwise identical on/off.
+    fused_residual_norm: bool = True
     fused_linear: bool = True  # kept for config parity; XLA fuses bias adds
     sequence_parallel: bool = False
     use_ring_attention: bool = False  # context parallelism over the seq axis
@@ -486,21 +492,40 @@ class GPTMlp(nn.Module):
 
 
 class LayerNorm(nn.Module):
-    """Pre-norm layer norm computed in f32 (bf16-safe)."""
+    """Pre-norm layer norm computed in f32 (bf16-safe).
+
+    With ``residual`` passed, the call folds the block residual add into
+    the norm and returns ``(norm_out, s)`` where ``s = residual + x`` is
+    the updated residual stream. Both forms dispatch to the fused Pallas
+    kernel (ops/fused_norm.py) when ``cfg.fused_residual_norm`` is on and
+    `fused_norm_supported` admits the shape; every rejected shape — and
+    the knob off — runs the unfused jnp line below, with bitwise-identical
+    f32 numerics either way (tests/test_zz_fusednorm.py).
+    """
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, residual: Optional[jax.Array] = None):
         cfg = self.cfg
         scale = self.param("scale", param_with_axes(nn.initializers.ones, ("norm",)),
                            (cfg.hidden_size,), cfg.param_dtype)
         bias = self.param("bias", param_with_axes(nn.initializers.zeros, ("norm",)),
                           (cfg.hidden_size,), cfg.param_dtype)
-        x32 = x.astype(jnp.float32)
+        from fleetx_tpu.ops import fused_norm
+
+        if cfg.fused_residual_norm and \
+                fused_norm.fused_norm_supported(x, residual):
+            out, s = fused_norm.fused_residual_norm(
+                x, scale, bias, residual=residual,
+                eps=cfg.layer_norm_epsilon, out_dtype=cfg.dtype)
+            return out if residual is None else (out, s)
+        s = x if residual is None else residual + x
+        x32 = s.astype(jnp.float32)
         mean = x32.mean(-1, keepdims=True)
         var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
         y = (x32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
-        return (y * scale + bias).astype(cfg.dtype)
+        out = (y * scale + bias).astype(cfg.dtype)
+        return out if residual is None else (out, s)
 
 
 class TransformerDecoderLayer(nn.Module):
@@ -534,10 +559,12 @@ class TransformerDecoderLayer(nn.Module):
 
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=False)
-        x = residual + y
+        # ln2 folds the post-attention residual add: `x = residual + y`
+        # rides inside the fused kernel (or the unfused fallback) and comes
+        # back as the updated stream alongside the normed MLP input.
+        y, x = LayerNorm(cfg, name="ln2")(y, residual=residual)
 
         residual = x
-        y = LayerNorm(cfg, name="ln2")(x)
         if cfg.moe_num_experts > 0:
             from fleetx_tpu.models.gpt.moe import MoEMlp
 

@@ -50,12 +50,17 @@ __all__ = [
 #: event-category taxonomy (docs/performance.md): the classifier's output
 #: values, in the order reports render them. Collectives carry a
 #: ``collective:<axis>`` suffix when the mesh axis is attributable.
-CATEGORIES = ("matmul", "flash", "dus", "copy", "collective",
+CATEGORIES = ("matmul", "flash", "fused_norm", "dus", "copy", "collective",
               "elementwise", "rng", "host_gap")
 
 # name substrings that mark a Pallas/Mosaic attention kernel (the repo's
 # flash fwd/dq/dkv custom calls are named attn._core_attn.*)
 _FLASH_MARKERS = ("attn", "flash")
+# the fused residual+LayerNorm kernels (ops/fused_norm.py) name their
+# pallas_calls fused_norm_fwd / fused_norm_bwd — matched NAME-FIRST, before
+# any hlo_category test, so the passes never fold back into `elementwise`
+# (whose deletion is exactly what the kernel's A/B measures)
+_FUSED_NORM_MARKER = "fused_norm"
 _COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
                        "all-to-all", "collective-permute",
                        "collective-broadcast")
@@ -148,6 +153,8 @@ def classify_event(name: str, hlo_category: str = "",
             if len(axes) == 1:
                 return f"collective:{axes[0]}"
         return "collective"
+    if _FUSED_NORM_MARKER in n:
+        return "fused_norm"
     if "dynamic-update-slice" in n or "dynamic-slice" in n or \
             cat == "dynamic-update-slice":
         return "dus"
@@ -451,6 +458,10 @@ def mfu_gap(decomp: dict, flops_per_step: Optional[float] = None,
         if cat == "collective" or cat.startswith("collective:"):
             axis = cat.partition(":")[2] or "unattributed"
             add(cat, cats[cat], f"collective time on mesh axis '{axis}'")
+    add("fused_norm", cats.get("fused_norm", 0.0),
+        "fused residual+LayerNorm+cast Pallas passes (ops/fused_norm.py) — "
+        "one HBM pass replacing the elementwise round-trips around each "
+        "norm", hbm_floor_ms=bw_floor("fused_norm"))
     add("elementwise", cats.get("elementwise", 0.0),
         "non-matmul compute (norms, softmax pieces, optimizer math)",
         hbm_floor_ms=bw_floor("elementwise"))
@@ -520,4 +531,8 @@ def summary(report: dict) -> dict:
     bwd = phases.get("bwd_scan") or {}
     if bwd.get("flash_passes_per_layer") is not None:
         out["bwd_flash_passes_per_layer"] = bwd["flash_passes_per_layer"]
+    # fused residual+norm flag (0/1 int — perf_gate's numeric schema
+    # rejects bools): did any fused_norm pallas pass land on the device?
+    cats = report.get("categories_ms_per_step") or {}
+    out["norm_fused"] = 1 if cats.get("fused_norm") else 0
     return out

@@ -109,18 +109,27 @@ def estimate_step_hbm_bytes(model: dict, micro_batch: int = 1,
 
 
 def _per_device_bytes(terms: dict, fsdp: int, mp: int, pp: int, seq: int,
-                      stage: int) -> float:
+                      stage: int, overlap: bool = False) -> float:
     """Shard the memory terms by what each ZeRO stage actually shards.
 
     The stage→term table is the registry's (``parallel/rules.py``
     ``ZERO_STAGE_TERMS``/``stage_shards``) — the same data that gates the
     engine's ``zero_sharding``/``zero_grad_specs`` calls, so the memory
     model and the runtime cannot disagree about what a stage distributes.
+
+    ``overlap`` is the engine's ``sharding.overlap_update``: params LIVE on
+    the grad shards between steps and the step gathers a full transient
+    copy inside the loss, so the weights peak grows by the resident
+    ``1/fsdp`` shard riding alongside the gathered copy — overlap buys step
+    time (the allgather hides under the forward), not memory.
     """
     mpp = max(mp * pp, 1)
     state = sum(
         terms[term] / (mpp * (fsdp if stage_shards(term, stage) else 1))
         for term in ("moments", "grads", "weights"))
+    if overlap and stage >= 2 and fsdp > 1 \
+            and not stage_shards("weights", stage):
+        state += terms["weights"] / (mpp * fsdp)
     return state + terms["act"] / (mpp * max(seq, 1))
 
 
@@ -146,7 +155,7 @@ def predicted_step_bytes(model: dict, degrees: dict | None = None,
     return _per_device_bytes(
         terms, fsdp, int(deg.get("mp_degree") or 1),
         int(deg.get("pp_degree") or 1), int(deg.get("seq_degree") or 1),
-        stage)
+        stage, overlap=bool(sh.get("overlap_update")))
 
 
 def advice_inputs(config: dict,

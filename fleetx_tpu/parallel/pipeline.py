@@ -33,8 +33,10 @@ from __future__ import annotations
 
 from typing import Any, Type
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.interpreters import pxla
 
 from fleetx_tpu.utils.log import logger
 
@@ -163,6 +165,21 @@ def _constrain(x: jnp.ndarray, axes: tuple) -> jnp.ndarray:
     return nn.with_logical_constraint(x, axes)
 
 
+def _replicate(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin ``x`` fully replicated with a *raw* sharding constraint.
+
+    flax's logical-constraint machinery is deliberately a no-op on CPU, so
+    it cannot express this pin on the CPU mesh where the bug bites; the raw
+    ``lax.with_sharding_constraint`` applies on every backend.  No-op
+    outside a mesh context (e.g. plain single-device traces).
+    """
+    mesh = pxla.thread_resources.env.physical_mesh
+    if mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+
+
 def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
                    num_microbatches: int, deterministic: bool = True,
                    num_repeats: int = 1) -> jnp.ndarray:
@@ -194,6 +211,13 @@ def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
     act_axes = ("batch", "act_seq", "act_embed")
     n_logical = S * V
 
+    # The [B] -> [M, mb] reshape must happen on an explicitly replicated
+    # array: when x arrives batch-sharded, GSPMD reshards the reshape/concat
+    # below with a masked all-reduce over the FULL device set, which sums the
+    # pipe-replicated copies and scales every activation by pp_degree.
+    # Pinning x replicated here compiles the reshard as a plain all-gather
+    # instead; the per-iteration shift constraint re-shards the compute.
+    x = _replicate(x)
     micro = x.reshape((M, mb) + rest)
     # bubble padding: the last S*V-1 iterations drain the pipe
     stream = jnp.concatenate(
@@ -237,8 +261,11 @@ def pipeline_apply(stages: nn.Module, x: jnp.ndarray, num_stages: int,
     shape0 = ((S,) if V == 1 else (V, S)) + (mb,) + rest
     shift0 = jnp.zeros(shape0, x.dtype)
     _, ys = run(stages, shift0, stream)
-    # iteration t drains microbatch t-(S*V-1); drop the ramp-up bubbles
-    out = ys[n_logical - 1:]
+    # iteration t drains microbatch t-(S*V-1); drop the ramp-up bubbles.
+    # Same replicate-before-reshape discipline as the ingest side: the
+    # [M, mb] -> [B] merge of a sharded dim otherwise hits the same
+    # pipe-summing reshard.
+    out = _replicate(ys[n_logical - 1:])
     return _constrain(out.reshape((batch,) + rest), act_axes)
 
 

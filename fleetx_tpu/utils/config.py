@@ -170,6 +170,7 @@ def process_dist_config(config: AttrDict, num_devices: int | None = None) -> Att
     sharding.setdefault("sharding_degree", degrees["fsdp_degree"])
     sharding.setdefault("sharding_stage", 1 if degrees["fsdp_degree"] > 1 else 0)
     sharding.setdefault("sharding_offload", False)
+    sharding.setdefault("overlap_update", False)
     return config
 
 

@@ -301,3 +301,33 @@ def test_pipeline_engine_loss_parity(devices8, pp):
     got = engp.fit([batch(seed=s) for s in range(3)])
 
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_sharded_batch_matches_replicated(devices8):
+    """Regression: a batch-sharded input must NOT change pipeline math.
+
+    GSPMD used to reshard the [B] -> [M, mb] microbatch reshape of a
+    batch-sharded input with a masked all-reduce over the full device set,
+    summing the pipe-replicated copies — every activation scaled by exactly
+    pp_degree (the root cause of the historic engine-parity drift).
+    ``pipeline_apply`` now pins the stream replicated across the reshape;
+    sharded and replicated inputs must agree bitwise.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    b = batch()
+    cfg = GPTConfig(**BASE, pp_degree=2, pp_microbatches=4)
+    model = GPTForPretraining(cfg)
+    mesh = build_mesh({"pp_degree": 2}, devices=devices8)
+    with mesh, nn.logical_axis_rules(make_axis_rules({"pp_degree": 2})):
+        params = meta.unbox(model.init(
+            {"params": jax.random.PRNGKey(0)}, b["tokens"], b["position_ids"],
+            deterministic=True)["params"])
+        fn = jax.jit(lambda p, t, pos: model.apply(
+            {"params": p}, t, pos, deterministic=True))
+        logits_rep = np.asarray(fn(params, b["tokens"], b["position_ids"]))
+        sh = NamedSharding(mesh, P(("data", "fsdp")))
+        logits_sh = np.asarray(fn(
+            params, jax.device_put(b["tokens"], sh),
+            jax.device_put(b["position_ids"], sh)))
+    np.testing.assert_array_equal(logits_sh, logits_rep)

@@ -453,7 +453,7 @@ def test_traced_sweep_promotes_fused_gate_rows(monkeypatch):
     exact-match row skip forever (review finding)."""
     import tools.tpu_watch as tw
 
-    def fake_bench_sweep(state, key, variants):
+    def fake_bench_sweep(state, key, variants, script="bench.py"):
         state[key] = {"value": 100.0, "batch_size": 8,
                       "_env": dict(variants[0][1])}
 

@@ -60,6 +60,16 @@ GATE_METRICS = {
     # split, exact-matched. Both skip when absent (pre-PR-13 baselines).
     "perf_bwd_ms_per_layer": ("lower", 0.10, 0.05),
     "flash_bwd_passes": ("exact", 0.0, 0.0),
+    # fused-norm + overlapped-update evidence (docs/bandwidth_levers.md):
+    # the elementwise trace line the fused kernel deletes regresses UP
+    # (its time re-appearing means the fusion stopped dispatching or the
+    # optimizer chain grew new pointwise passes), and the two 0/1 path
+    # flags exact-match — a silent flip to the fallback is a compiled-
+    # program change, not noise. All skip when absent (pre-PR-20
+    # baselines).
+    "perf_elementwise_ms": ("lower", 0.10, 0.05),
+    "norm_fused": ("exact", 0.0, 0.0),
+    "update_overlapped": ("exact", 0.0, 0.0),
 }
 #: per-phase span means are noisier than the headline (host scheduling):
 #: wide relative band + a 0.5 ms absolute floor
@@ -281,6 +291,27 @@ def self_check(baseline_entry: dict) -> list[str]:
     drifted["perf_bwd_ms_per_layer"] = 6.0
     rows = compare(drifted, seeded)
     for metric in ("flash_bwd_passes", "perf_bwd_ms_per_layer"):
+        if not any(r["metric"] == metric and r["verdict"] == "FAIL"
+                   for r in rows):
+            problems.append(f"synthetic {metric} regression NOT caught")
+    # fused-norm / overlapped-update rows self-check on synthetic values
+    # (their real rows skip-if-absent on pre-PR-20 baselines): identical
+    # copies pass, ANY path-flag flip must exact-match FAIL, and an
+    # elementwise-line regrowth past its 10% band must fail
+    fn = dict(baseline_entry)
+    fn["norm_fused"] = 1
+    fn["update_overlapped"] = 1
+    fn["perf_elementwise_ms"] = 4.0
+    rows = compare(dict(fn), fn)
+    if any(r["verdict"] == "FAIL" for r in rows):
+        problems.append("identical fused-norm rows flagged as regression")
+    drifted_fn = dict(fn)
+    drifted_fn["norm_fused"] = 0
+    drifted_fn["update_overlapped"] = 0
+    drifted_fn["perf_elementwise_ms"] = 5.0
+    rows = compare(drifted_fn, fn)
+    for metric in ("norm_fused", "update_overlapped",
+                   "perf_elementwise_ms"):
         if not any(r["metric"] == metric and r["verdict"] == "FAIL"
                    for r in rows):
             problems.append(f"synthetic {metric} regression NOT caught")

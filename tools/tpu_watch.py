@@ -322,13 +322,16 @@ def _traced_sweep(state: dict, key: str, variants,
                           "decomposition_error", "hbm_stats",
                           "hbm_peak_bytes", "hbm_model_error",
                           "flash_fused_bwd", "flash_bwd_passes",
-                          "perf_bwd_ms_per_layer")
+                          "perf_bwd_ms_per_layer", "norm_fused",
+                          "update_overlapped", "perf_elementwise_ms")
                          if k in tres}
-        # promote the fused-backward gate rows to the ENTRY's top level:
-        # tools/perf_gate.py looks metrics up by top-level dotted path in
-        # the baseline entry, so values left only under "traced" would
-        # make the exact-match flash_bwd_passes row skip forever
-        for key_name in ("flash_bwd_passes", "perf_bwd_ms_per_layer"):
+        # promote the fused-backward / fused-norm / overlap gate rows to
+        # the ENTRY's top level: tools/perf_gate.py looks metrics up by
+        # top-level dotted path in the baseline entry, so values left only
+        # under "traced" would make the exact-match rows skip forever
+        for key_name in ("flash_bwd_passes", "perf_bwd_ms_per_layer",
+                         "norm_fused", "update_overlapped",
+                         "perf_elementwise_ms"):
             if key_name in tres and key_name not in res:
                 res[key_name] = tres[key_name]
         res["_trace_dir"] = trace_dir
@@ -494,6 +497,49 @@ def _capture_gpt_fusedbwd(state: dict) -> None:
                     {"flash_fused_bwd": False})])
 
 
+def _capture_gpt_fusednorm(state: dict) -> None:
+    """Fused residual+LayerNorm A/B (docs/bandwidth_levers.md): same
+    config as gpt_policyfix with FLEETX_BENCH_FUSED_NORM forcing each
+    side — fused folds the residual add, the f32 norm and the output
+    cast into ONE Pallas HBM pass per pre-norm site, unfused pays the
+    separate elementwise round-trips XLA bills around every LayerNorm
+    (the `elementwise` line of the committed trace decomposition). The
+    untraced sweep keeps the faster side; the traced re-run's
+    decomposition carries norm_fused + perf_elementwise_ms so the
+    deleted-line claim is verifiable from the report alone, and
+    tools/perf_gate.py gates both thereafter. Read against
+    gpt_policyfix. Traced (PR 10 contract)."""
+    _traced_sweep(state, "gpt_fusednorm",
+                  [("_fused", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                               "FLEETX_BENCH_FUSED_NORM": "1"},
+                    {"fused_residual_norm": True}),
+                   ("_unfused", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                                 "FLEETX_BENCH_FUSED_NORM": "0"},
+                    {"fused_residual_norm": False})])
+
+
+def _capture_gpt_overlap_update(state: dict) -> None:
+    """Overlapped sharded update A/B (docs/bandwidth_levers.md): the
+    gpt_zero2 config with FLEETX_BENCH_OVERLAP_UPDATE forcing each side —
+    overlapped keeps params resident on the ZeRO-2 grad shards and moves
+    the allgather into the loss where XLA schedules it against the next
+    step's forward; off pays the tail allgather after the optimizer. On
+    the single-chip tunnel fsdp=1 demotes the knob (update_overlapped
+    reports 0 either way) and the capture audits code-path overhead; on a
+    multi-chip mesh the traced decomposition shows the collective:fsdp
+    time migrating out of the outside-the-scans tail. Read against
+    gpt_zero2. Traced (PR 10 contract)."""
+    _traced_sweep(state, "gpt_overlap_update",
+                  [("_overlap", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                                 "FLEETX_BENCH_ZERO_STAGE": "2",
+                                 "FLEETX_BENCH_OVERLAP_UPDATE": "1"},
+                    {"overlap_update": True}),
+                   ("_tail", {"FLEETX_BENCH_RECOMPUTE": "dots",
+                              "FLEETX_BENCH_ZERO_STAGE": "2",
+                              "FLEETX_BENCH_OVERLAP_UPDATE": "0"},
+                    {"overlap_update": False})])
+
+
 _SERVING_CFG = os.path.join("fleetx_tpu", "configs", "nlp", "gpt",
                             "serving_gpt_345M.yaml")
 
@@ -538,6 +584,8 @@ CAPTURES = [
     ("gpt_zero2", _capture_gpt_zero2),
     ("gpt_fusedbwd", _capture_gpt_fusedbwd),
     ("gpt_paged_kernel", _capture_gpt_paged_kernel),
+    ("gpt_fusednorm", _capture_gpt_fusednorm),
+    ("gpt_overlap_update", _capture_gpt_overlap_update),
 ]
 
 
